@@ -1,0 +1,36 @@
+"""dmlcloud_tpu — a TPU-native distributed-training framework.
+
+Same capabilities as sehoffmann/dmlcloud (Pipeline/Stage lifecycle, one-call
+cluster bootstrap, distributed metrics, checkpoint dirs with requeue-resume,
+reproducibility diagnostics, W&B glue, dataset sharding), rebuilt idiomatically
+on JAX/XLA: device meshes + NamedSharding instead of DDP, one compiled donated
+step instead of hook-driven allreduce, the jax.distributed coordination
+service instead of c10d rendezvous, and Orbax for sharded tensor state.
+"""
+
+from . import data, metrics, parallel, utils
+from .checkpoint import CheckpointDir, find_slurm_checkpoint, generate_checkpoint_path
+from .metrics import MetricReducer, MetricTracker, Reduction
+from .pipeline import TrainingPipeline
+from .stage import Stage, TrainValStage
+from .train_state import TrainState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "data",
+    "metrics",
+    "parallel",
+    "utils",
+    "CheckpointDir",
+    "find_slurm_checkpoint",
+    "generate_checkpoint_path",
+    "MetricReducer",
+    "MetricTracker",
+    "Reduction",
+    "TrainingPipeline",
+    "Stage",
+    "TrainValStage",
+    "TrainState",
+    "__version__",
+]

@@ -1,0 +1,27 @@
+from .datasets import (
+    BatchDataset,
+    DownstreamDataset,
+    PrefetchDataset,
+    ShardedSequenceDataset,
+    ShardedXrDataset,
+    interleave_batches,
+    interleave_dict_batches,
+    sharded_xr_dataset,
+)
+from .device import device_iterator
+from .sharding import chunk_and_shard_indices, shard_indices, shard_sequence
+
+__all__ = [
+    "BatchDataset",
+    "DownstreamDataset",
+    "PrefetchDataset",
+    "ShardedSequenceDataset",
+    "ShardedXrDataset",
+    "interleave_batches",
+    "interleave_dict_batches",
+    "sharded_xr_dataset",
+    "device_iterator",
+    "chunk_and_shard_indices",
+    "shard_indices",
+    "shard_sequence",
+]

@@ -1,0 +1,341 @@
+"""Sharded iterable datasets, prefetching, batching, and batch interleaving.
+
+Capability parity with /root/reference/dmlcloud/util/data.py:70-341, torch-free
+at the core (numpy buffers instead of pinned torch tensors) but compatible
+with ``torch.utils.data.DataLoader``: when torch is importable the dataset
+base class is ``torch.utils.data.IterableDataset`` and worker sub-sharding
+via ``get_worker_info`` works exactly like the reference (effective rank =
+``rank * num_workers + worker_id``, data.py:133-138).
+
+The xarray chunk reader is duck-typed (anything with ``.isel``/indexable dims
+works), so xarray stays an optional dependency.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from ..parallel import runtime
+from .sharding import chunk_and_shard_indices, shard_sequence
+
+try:  # torch is optional; used only for DataLoader interop
+    from torch.utils.data import IterableDataset as _TorchIterableDataset, get_worker_info as _get_worker_info
+
+    _DatasetBase = _TorchIterableDataset
+except ImportError:  # pragma: no cover
+    _DatasetBase = object
+
+    def _get_worker_info():
+        return None
+
+
+def _effective_rank_world(rank: int, world_size: int) -> tuple[int, int]:
+    """Sub-shard across DataLoader workers: each (rank, worker) pair becomes a
+    distinct effective rank (reference data.py:131-138)."""
+    info = _get_worker_info()
+    if info is None:
+        return rank, world_size
+    return rank * info.num_workers + info.id, world_size * info.num_workers
+
+
+def sharded_xr_dataset(
+    ds: Any,
+    dim: str,
+    chunk_size: int,
+    chunk_overlap: int = 0,
+    even_shards: bool = True,
+    equal_chunks: bool = True,
+    shuffle: bool = False,
+    seed: int = 0,
+    rank: int | None = None,
+    world_size: int | None = None,
+    load: bool = False,
+    load_kwargs: dict | None = None,
+) -> Iterator[Any]:
+    """Lazily slice an xarray Dataset/DataArray (or any ``.isel``-capable
+    object) along ``dim`` into per-rank chunks (reference data.py:70-107).
+    ``chunk_overlap`` yields overlapping windows for time-series context."""
+    if rank is None:
+        rank = runtime.rank()
+    if world_size is None:
+        world_size = runtime.world_size()
+
+    num_elements = len(ds[dim]) if hasattr(ds, "__getitem__") and not isinstance(ds, np.ndarray) else ds.sizes[dim]
+    chunks = chunk_and_shard_indices(
+        num_elements,
+        chunk_size,
+        rank,
+        world_size,
+        chunk_overlap=chunk_overlap,
+        even_shards=even_shards,
+        equal_chunks=equal_chunks,
+        shuffle=shuffle,
+        seed=seed,
+    )
+    for start, end in chunks:
+        chunk = ds.isel({dim: slice(start, end)})
+        if load:
+            chunk.load(**(load_kwargs or {}))
+        yield chunk
+
+
+class ShardedSequenceDataset(_DatasetBase):
+    """Iterable over this rank's share of a sequence, reshuffled per epoch via
+    ``set_epoch`` (reference data.py:110-147)."""
+
+    def __init__(
+        self,
+        sequence: Sequence,
+        shuffle: bool = False,
+        even_shards: bool = True,
+        seed: int = 0,
+        rank: int | None = None,
+        world_size: int | None = None,
+    ):
+        self.sequence = sequence
+        self.shuffle = shuffle
+        self.even_shards = even_shards
+        self.seed = seed
+        self.rank = rank if rank is not None else runtime.rank()
+        self.world_size = world_size if world_size is not None else runtime.world_size()
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        if self.even_shards:
+            return len(self.sequence) // self.world_size
+        n, r = divmod(len(self.sequence), self.world_size)
+        return n + (1 if self.rank < r else 0)
+
+    def __iter__(self):
+        rank, world_size = _effective_rank_world(self.rank, self.world_size)
+        shards = shard_sequence(
+            self.sequence,
+            rank,
+            world_size,
+            shuffle=self.shuffle,
+            even_shards=self.even_shards,
+            seed=self.seed + self.epoch,
+        )
+        return iter(shards)
+
+
+class ShardedXrDataset(_DatasetBase):
+    """Iterable over this rank's chunks of an xarray-like dataset
+    (reference data.py:150-207)."""
+
+    def __init__(
+        self,
+        ds: Any,
+        dim: str,
+        chunk_size: int,
+        chunk_overlap: int = 0,
+        even_shards: bool = True,
+        equal_chunks: bool = True,
+        shuffle: bool = False,
+        seed: int = 0,
+        rank: int | None = None,
+        world_size: int | None = None,
+        load: bool = False,
+        load_kwargs: dict | None = None,
+    ):
+        self.ds = ds
+        self.dim = dim
+        self.chunk_size = chunk_size
+        self.chunk_overlap = chunk_overlap
+        self.even_shards = even_shards
+        self.equal_chunks = equal_chunks
+        self.shuffle = shuffle
+        self.seed = seed
+        self.load = load
+        self.load_kwargs = load_kwargs
+        self.rank = rank if rank is not None else runtime.rank()
+        self.world_size = world_size if world_size is not None else runtime.world_size()
+        self._num_iters = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._num_iters = epoch
+
+    def __iter__(self):
+        rank, world_size = _effective_rank_world(self.rank, self.world_size)
+        return sharded_xr_dataset(
+            self.ds,
+            self.dim,
+            self.chunk_size,
+            chunk_overlap=self.chunk_overlap,
+            even_shards=self.even_shards,
+            equal_chunks=self.equal_chunks,
+            shuffle=self.shuffle,
+            seed=self.seed + self._num_iters,
+            rank=rank,
+            world_size=world_size,
+            load=self.load,
+            load_kwargs=self.load_kwargs,
+        )
+
+
+class DownstreamDataset(_DatasetBase):
+    """Base for dataset wrappers: forwards ``set_epoch`` and ``__len__``
+    (reference data.py:210-219)."""
+
+    def __init__(self, source_ds: Iterable):
+        self.source_ds = source_ds
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.source_ds, "set_epoch"):
+            self.source_ds.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.source_ds)
+
+
+class PrefetchDataset(DownstreamDataset):
+    """Background-thread lookahead of ``num_elements`` items (reference
+    data.py:222-240) — keeps host-side IO off the training thread's critical
+    path so the TPU dispatch queue stays full."""
+
+    def __init__(self, source_ds: Iterable, num_elements: int):
+        super().__init__(source_ds)
+        self.num_elements = num_elements
+
+    def __iter__(self):
+        pool = ThreadPoolExecutor(max_workers=1)
+        iter_ = iter(self.source_ds)
+        with pool:
+            futures = [pool.submit(next, iter_) for _ in range(self.num_elements)]
+            while True:
+                future = futures.pop(0)
+                try:
+                    element = future.result()
+                except StopIteration:
+                    return
+                futures.append(pool.submit(next, iter_))
+                yield element
+
+
+class BatchDataset(DownstreamDataset):
+    """Group consecutive elements into lists of ``batch_size`` (reference
+    data.py:243-263)."""
+
+    def __init__(self, source_ds: Iterable, batch_size: int, drop_remainder: bool = False):
+        super().__init__(source_ds)
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def __len__(self) -> int:
+        n = len(self.source_ds)
+        if self.drop_remainder:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        batch = []
+        for element in self.source_ds:
+            batch.append(element)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_remainder:
+            yield batch
+
+
+def interleave_batches(
+    iterable: Iterable[np.ndarray], num_batches: int
+) -> Iterator[np.ndarray]:
+    """Re-slice ``num_batches`` consecutive batches into ``num_batches`` mixed
+    batches through one preallocated buffer (reference data.py:266-301).
+    Yielded views alias the buffer — consume or copy immediately.
+
+    Useful when chunked sequential reads (e.g. xarray time chunks) would give
+    each batch correlated content: interleaving restores within-batch mixing
+    at memcpy cost, no extra allocation per batch. See also
+    ``dmlcloud_tpu.native.fast_interleave`` for the C++ path used
+    automatically when the extension is built.
+    """
+    if num_batches < 1:
+        raise ValueError("num_batches must be greater than 0")
+    if num_batches == 1:
+        yield from iterable
+        return
+
+    try:
+        from ..native import interleave as _native
+    except Exception:
+        _native = None
+
+    batches: list[np.ndarray] = []
+    memory = None
+    slice_size = None
+    for batch in iterable:
+        batch = np.asarray(batch)
+        if memory is None:
+            batch_size = batch.shape[0]
+            slice_size = batch_size // num_batches
+            if batch_size % num_batches != 0:
+                raise ValueError(
+                    f"Batch dimension ({batch_size}) must be divisible by num_batches={num_batches}"
+                )
+            memory = np.empty((num_batches, *batch.shape), dtype=batch.dtype)
+
+        batches.append(batch)
+
+        if len(batches) == num_batches:
+            if (
+                _native is not None
+                and _native.available()
+                and all(b.flags.c_contiguous for b in batches)
+            ):
+                _native.interleave_into(memory, batches, slice_size)
+            else:
+                for i in range(num_batches):
+                    for j in range(num_batches):
+                        memory[i, j * slice_size : (j + 1) * slice_size] = batches[j][
+                            i * slice_size : (i + 1) * slice_size
+                        ]
+            batches = []
+            for i in range(num_batches):
+                yield memory[i]
+
+
+def interleave_dict_batches(
+    iterable: Iterable[dict[str, np.ndarray]], num_batches: int
+) -> Iterator[dict[str, np.ndarray]]:
+    """Dict-of-arrays variant of ``interleave_batches`` (reference
+    data.py:304-341). Yielded dicts alias the buffers — consume immediately."""
+    if num_batches < 1:
+        raise ValueError("num_batches must be greater than 0")
+    if num_batches == 1:
+        yield from iterable
+        return
+
+    batches: list[dict[str, np.ndarray]] = []
+    memory: dict[str, np.ndarray] = {}
+    slice_size: dict[str, int] = {}
+    for batch in iterable:
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        if not memory:
+            for k, arr in batch.items():
+                batch_size = arr.shape[0]
+                if batch_size % num_batches != 0:
+                    raise ValueError(
+                        f"Batch dimension ({batch_size}) must be divisible by num_batches={num_batches}"
+                    )
+                slice_size[k] = batch_size // num_batches
+                memory[k] = np.empty((num_batches, *arr.shape), dtype=arr.dtype)
+
+        batches.append(batch)
+
+        if len(batches) == num_batches:
+            for k in memory:
+                s = slice_size[k]
+                for i in range(num_batches):
+                    for j in range(num_batches):
+                        memory[k][i, j * s : (j + 1) * s] = batches[j][k][i * s : (i + 1) * s]
+            batches = []
+            for i in range(num_batches):
+                yield {k: memory[k][i] for k in memory}

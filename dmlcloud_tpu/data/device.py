@@ -1,0 +1,46 @@
+"""Host->device feeding: sharded transfer + double-buffered device prefetch.
+
+The reference leaves host->device transfer to user code / DDP; on TPU the
+transfer schedule matters: overlapping the next batch's host->HBM copy with
+the current step hides DCN/PCIe latency entirely. ``device_iterator`` wraps
+any host-batch iterator into a pipeline that keeps ``prefetch`` batches
+resident on device, already laid out with the mesh's batch sharding.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterable, Iterator
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+
+
+def device_iterator(
+    it: Iterable[Any],
+    mesh: Mesh,
+    pspec: P | None = None,
+    prefetch: int = 2,
+) -> Iterator[Any]:
+    """Yield device-resident, mesh-sharded batches, keeping ``prefetch``
+    transfers in flight ahead of consumption.
+
+    jax transfers are async: ``device_put`` returns immediately and the copy
+    overlaps compute, so a small ``prefetch`` suffices to fully hide it.
+    """
+    queue: collections.deque = collections.deque()
+    src = iter(it)
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                batch = next(src)
+            except StopIteration:
+                return
+            queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
+
+    enqueue(max(prefetch, 1))
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -1,0 +1,2 @@
+"""Model zoo: TPU-friendly flax implementations for the BASELINE.json ladder
+(MNIST CNN, ResNet-50, BERT-style encoder, ViT, Llama-style decoder LM)."""

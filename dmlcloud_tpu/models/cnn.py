@@ -1,0 +1,33 @@
+"""Small convnet for the MNIST example pair — the model the reference's
+examples/mnist.py:28-41 builds with torch.nn, re-expressed in flax.
+
+TPU notes: NHWC layout (XLA:TPU's native conv layout), bf16-friendly compute
+with fp32 params, matmul-heavy head so the MXU does the work.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """conv(32) -> conv(64) -> maxpool -> dense(128) -> dense(10)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, 28, 28, 1] (NHWC)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,209 @@
+"""Decoder-only transformer LM (Llama-style) — the BASELINE.json
+"Llama-3-8B pretrain (FSDP -> pjit named-sharding)" config family, built
+TPU-first:
+
+- RMSNorm (fp32 accumulation), rotary position embeddings, grouped-query
+  attention, SwiGLU MLP — the modern decoder recipe.
+- bf16 activations / fp32 params; every matmul shaped for the MXU.
+- Tensor parallelism is expressed as data, not code: ``partition_rules()``
+  returns T5X-style (regex -> PartitionSpec) rules that shard attention heads
+  and MLP hidden over the ``model`` axis and everything else over ``fsdp``.
+  XLA inserts the all-reduces; no Megatron-style manual f/g collectives.
+- Attention pluggability: ``attn_impl`` picks 'dot' (reference einsum path),
+  'flash' (Pallas TPU kernel, ops/flash_attention.py), or 'ring'
+  (sequence-parallel ring attention over the ``seq`` axis,
+  ops/ring_attention.py) — the long-context path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int | None = None  # None => MHA; < num_heads => GQA
+    head_dim: int = 64
+    hidden_dim: int = 512
+    mlp_dim: int = 1408  # ~8/3 * hidden, SwiGLU convention
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    attn_impl: str = "dot"  # 'dot' | 'flash' | 'ring'
+    seq_axis: str = "seq"  # mesh axis used when attn_impl == 'ring'
+    # Mesh for attn_impl='ring' under plain jit (ring_attention_sharded wraps
+    # itself in shard_map); leave None when the step is already shard_mapped.
+    mesh: Any = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def llama_partition_rules() -> list[tuple[str, P]]:
+    """T5X-style sharding rules for this model family: embeddings and heads
+    over ``model`` (tensor parallel), with ``fsdp`` sharding the other large
+    axis. Axes missing from the active mesh are dropped automatically
+    (parallel/mesh.py make_param_policy)."""
+    return [
+        ("embed/embedding", P("model", "fsdp")),
+        ("attn/(q|k|v)_proj/kernel", P("fsdp", "model")),
+        ("attn/o_proj/kernel", P("model", "fsdp")),
+        ("mlp/(gate|up)_proj/kernel", P("fsdp", "model")),
+        ("mlp/down_proj/kernel", P("model", "fsdp")),
+        ("lm_head/kernel", P("fsdp", "model")),
+        ("norm", P()),
+        (".*", P()),
+    ]
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32**2, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [T, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0) -> jnp.ndarray:
+    """x: [B, T, H, D]. Rotates pairs (even, odd) of the head dim."""
+    seq_len = x.shape[1]
+    cos = jax.lax.dynamic_slice_in_dim(cos, offset, seq_len)[None, :, None, :]
+    sin = jax.lax.dynamic_slice_in_dim(sin, offset, seq_len)[None, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _dot_attention(q, k, v, causal: bool = True):
+    """Reference attention: fp32 softmax, bf16 matmuls. q:[B,T,H,D] k/v:[B,S,K,D]."""
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    q = q.reshape(b, t, kh, group, d)
+    scores = jnp.einsum("btkgd,bskd->bkgts", q, k).astype(jnp.float32) / jnp.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(b, t, h, d)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        b, t, _ = x.shape
+        q = dense((cfg.num_heads, cfg.head_dim), "q_proj")(x)
+        k = dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x)
+        v = dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x)
+
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        if cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif cfg.attn_impl == "ring":
+            if cfg.mesh is not None:
+                from ..ops.ring_attention import ring_attention_sharded
+
+                out = ring_attention_sharded(q, k, v, cfg.mesh, axis_name=cfg.seq_axis, causal=True)
+            else:
+                from ..ops.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
+        else:
+            out = _dot_attention(q, k, v, causal=True)
+
+        out = out.reshape(b, t, cfg.num_heads * cfg.head_dim)
+        return nn.DenseGeneral(
+            cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj"
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        gate = dense(cfg.mlp_dim, "gate_proj")(x)
+        up = dense(cfg.mlp_dim, "up_proj")(x)
+        return dense(cfg.hidden_dim, "down_proj")(nn.silu(gate) * up)
+
+
+class DecoderBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class DecoderLM(nn.Module):
+    """Causal LM: tokens [B, T] int32 -> logits [B, T, vocab] fp32."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
+        )(tokens)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+        for i in range(cfg.num_layers):
+            x = DecoderBlock(cfg, name=f"layer_{i}")(x, cos, sin)
+
+        x = RMSNorm(name="final_norm")(x)
+        if cfg.tie_embeddings:
+            embed = self.variables["params"]["embed"]["embedding"]
+            logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embed.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
+            )(x)
+        return logits
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy over shifted targets."""
+    import optax
+
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()

@@ -1,0 +1,16 @@
+"""Native (C++) runtime components, loaded via ctypes with Python fallbacks.
+
+The reference is pure Python and delegates native work to torch's C++
+(SURVEY.md §2.1 language note). Here the host-side hot paths that torch used
+to cover get their own small C++ library (``libdmltpu.so``, built by
+``native/build.sh`` or ``python -m dmlcloud_tpu.native.build``):
+
+- ``interleave``: parallel strided memcpy batch interleaving (the inner loop
+  of ``data.interleave_batches``).
+
+Every entry point degrades gracefully to numpy when the library isn't built.
+"""
+
+from . import interleave
+
+__all__ = ["interleave"]

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build libdmltpu.so next to this script. Requires g++ (baked in the image).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -fPIC -shared -std=c++17 -pthread -o libdmltpu.so interleave.cpp
+echo "built $(pwd)/libdmltpu.so"

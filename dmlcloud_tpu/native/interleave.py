@@ -1,0 +1,60 @@
+"""ctypes binding for the C++ batch-interleave kernel (falls back to numpy).
+
+See native/interleave.cpp. The Python loop in ``interleave_batches``
+(data/datasets.py) does num_batches^2 strided copies per group through the
+interpreter; the C++ path does the same copies with std::memcpy across a
+thread pool — bandwidth-bound instead of interpreter-bound.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = Path(__file__).parent / "libdmltpu.so"
+    if so.exists():
+        try:
+            lib = ctypes.CDLL(str(so))
+            lib.dmltpu_interleave.restype = ctypes.c_int
+            lib.dmltpu_interleave.argtypes = [
+                ctypes.c_void_p,  # dst
+                ctypes.POINTER(ctypes.c_void_p),  # srcs
+                ctypes.c_long,  # num_batches
+                ctypes.c_long,  # slice_bytes
+                ctypes.c_long,  # batch_bytes
+            ]
+            _LIB = lib
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def interleave_into(memory: np.ndarray, batches: list[np.ndarray], slice_size: int) -> None:
+    """memory[i, j*s:(j+1)*s] = batches[j][i*s:(i+1)*s] for all i, j — in C++."""
+    lib = _load()
+    n = len(batches)
+    itemsize = batches[0].itemsize
+    row_bytes = int(np.prod(batches[0].shape[1:])) * itemsize if batches[0].ndim > 1 else itemsize
+    slice_bytes = slice_size * row_bytes
+    batch_bytes = batches[0].shape[0] * row_bytes
+    srcs = (ctypes.c_void_p * n)(*[b.ctypes.data for b in batches])
+    rc = lib.dmltpu_interleave(
+        memory.ctypes.data, srcs, n, slice_bytes, batch_bytes
+    )
+    if rc != 0:  # pragma: no cover
+        raise RuntimeError(f"dmltpu_interleave failed with code {rc}")

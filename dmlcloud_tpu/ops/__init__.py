@@ -1,0 +1,2 @@
+"""Custom ops: Pallas TPU kernels and sharded collective ops (flash attention,
+ring attention for sequence/context parallelism, fused cross-entropy)."""

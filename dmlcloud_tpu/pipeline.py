@@ -1,0 +1,396 @@
+"""TrainingPipeline: the experiment orchestrator.
+
+Capability parity with /root/reference/dmlcloud/pipeline.py:20-331 — config
+container, registries for models/optimizers/schedulers/datasets/stages,
+checkpoint + wandb enablement, run lifecycle with cleanup guard, barriers with
+timeout, diagnostics — re-based on the TPU runtime:
+
+- device selection (pipeline.py:231-242) becomes mesh construction: the
+  pipeline owns a ``jax.sharding.Mesh`` (default: one ``data`` axis over all
+  devices — DDP semantics) that every stage's compiled step is sharded over.
+- ``register_model``'s DDP wrap (pipeline.py:72-74) becomes laying params out
+  on the mesh under a sharding policy ('replicate' == DDP, 'fsdp' == ZeRO-3,
+  rule list == tensor parallel).
+- the gloo side-group for timeout barriers (pipeline.py:226-229) becomes the
+  coordination-service monitored barrier (parallel/runtime.py).
+- optimizers are optax transformations; schedulers are optax schedules.
+- checkpointing keeps the directory contract and adds Orbax tensor state
+  (checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Optional
+
+import jax
+
+from .checkpoint import CheckpointDir, find_slurm_checkpoint, generate_checkpoint_path
+from .metrics import MetricTracker, Reduction
+from .parallel import mesh as mesh_lib
+from .parallel import runtime
+from .stage import Stage
+from .utils.config import Config, as_config
+from .utils.logging import IORedirector, add_log_handlers, experiment_header, general_diagnostics
+from .utils.wandb import wandb, wandb_is_initialized, wandb_set_startup_timeout
+
+
+@dataclass
+class ModelEntry:
+    name: str
+    module: Any  # flax module or None
+    apply_fn: Callable
+    params: Any
+    policy: Any = "replicate"
+    extras: Any = None  # non-trained collections (batch_stats, ...)
+
+
+class TrainingPipeline:
+    def __init__(self, config: Any = None, name: Optional[str] = None):
+        self.config: Config = as_config(config)
+        self.name = name
+
+        self.logger = logging.getLogger("dmlcloud_tpu")
+        self.checkpoint_dir: CheckpointDir | None = None
+        self.io_redirector = None
+        self.resumed: bool | None = None
+        self.tracker = MetricTracker()
+        self.mesh = None
+        self.root_key = None
+        self.start_time = None
+        self.stop_time = None
+        self.current_stage = None
+
+        self.wandb = False
+        self._wandb_initializer = None
+
+        self.stages: list[Stage] = []
+        self.datasets: dict[str, Any] = {}
+        self.models: dict[str, ModelEntry] = {}
+        self.optimizers: dict[str, Any] = {}
+        self.schedulers: dict[str, Any] = {}
+        self._optimizer_model: dict[str, str | None] = {}
+
+    # ------------------------------------------------------------------ mesh
+    @property
+    def checkpointing_enabled(self) -> bool:
+        return self.checkpoint_dir is not None
+
+    def set_mesh(self, mesh_or_axes) -> None:
+        """Set the device mesh (a ``jax.sharding.Mesh`` or an axes dict like
+        ``{'data': -1}`` / ``{'data': 2, 'model': 4}``). Default if never
+        called: a single ``data`` axis over all devices."""
+        if isinstance(mesh_or_axes, dict):
+            self.mesh = mesh_lib.create_mesh(mesh_or_axes)
+        else:
+            self.mesh = mesh_or_axes
+
+    # ----------------------------------------------------------- registries
+    def register_model(
+        self,
+        name: str,
+        model: Any = None,
+        params: Any = None,
+        apply_fn: Callable | None = None,
+        sharding: Any = "replicate",
+        init_args: tuple | None = None,
+        init_rng: int | jax.Array = 0,
+        verbose: bool = True,
+    ):
+        """Register a model and lay its params out on the mesh.
+
+        Accepts a flax module (``apply_fn = model.apply``; if ``params`` is
+        None they are initialised from ``init_args`` example inputs), or an
+        explicit ``(apply_fn, params)`` pair. ``sharding`` is the param
+        policy: 'replicate' (DDP semantics, reference pipeline.py:72-74),
+        'fsdp', a T5X-style rule list, or a callable.
+        """
+        if name in self.models:
+            raise ValueError(f"Model with name {name} already exists")
+        if self.mesh is None:
+            self._init_mesh()
+
+        extras = None
+        if model is not None and hasattr(model, "apply") and hasattr(model, "init"):
+            apply_fn = model.apply
+            if params is None:
+                if init_args is None:
+                    raise ValueError("params=None requires init_args example inputs for module.init")
+                rng = jax.random.PRNGKey(init_rng) if isinstance(init_rng, int) else init_rng
+                params = model.init(rng, *init_args)
+        elif apply_fn is None:
+            if not callable(model):
+                raise ValueError("register_model needs a flax module, or apply_fn + params")
+            apply_fn = model
+
+        # flax variables: split trained params from mutable collections
+        if isinstance(params, dict) and "params" in params:
+            variables = dict(params)
+            params = variables.pop("params")
+            extras = variables or None
+
+        params = mesh_lib.shard_pytree(params, self.mesh, sharding)
+        if extras is not None:
+            extras = mesh_lib.shard_pytree(extras, self.mesh, sharding)
+        self.models[name] = ModelEntry(
+            name=name, module=model, apply_fn=apply_fn, params=params, policy=sharding, extras=extras
+        )
+
+        if verbose:
+            n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params) if hasattr(x, "size"))
+            msg = f'Model "{name}":\n'
+            msg += f"    - Parameters: {n_params / 1e6:.1f} M\n"
+            msg += f"    - Sharding policy: {sharding if isinstance(sharding, str) else 'custom rules'}\n"
+            msg += f"    - Mesh: {dict(self.mesh.shape) if self.mesh is not None else None}"
+            self.logger.info(msg)
+
+    def register_optimizer(self, name: str, optimizer, scheduler=None, model: str | None = None):
+        """Register an optax transformation (and optionally its schedule, for
+        LR tracking parity with reference stage.py:316-318)."""
+        if name in self.optimizers:
+            raise ValueError(f"Optimizer with name {name} already exists")
+        self.optimizers[name] = optimizer
+        self._optimizer_model[name] = model
+        if scheduler is not None:
+            self.schedulers[name] = scheduler
+
+    def register_dataset(self, name: str, dataset: Any, verbose: bool = True):
+        if name in self.datasets:
+            raise ValueError(f"Dataset with name {name} already exists")
+        self.datasets[name] = dataset
+        if verbose:
+            msg = f'Dataset "{name}":\n'
+            try:
+                length = len(dataset)
+                msg += f"    - Batches (Total): ~{length * runtime.world_size()}\n"
+                msg += f"    - Batches (/Worker): {length}\n"
+            except TypeError:
+                msg += "    - Batches (Total): N/A\n"
+                msg += "    - Batches (/Worker): N/A\n"
+            self.logger.info(msg)
+
+    def append_stage(self, stage: Stage, max_epochs: Optional[int] = None, name: Optional[str] = None):
+        if not isinstance(stage, Stage):
+            raise ValueError("stage must be a Stage object")
+        stage.pipeline = self
+        stage.max_epochs = max_epochs
+        stage.name = name or type(stage).__name__
+        self.stages.append(stage)
+
+    # -- registry lookups used by TrainValStage -----------------------------
+    def _model_entry(self, name: str | None = None) -> ModelEntry:
+        if name is not None:
+            if name not in self.models:
+                raise ValueError(f"No model named {name!r} registered")
+            return self.models[name]
+        if len(self.models) == 1:
+            return next(iter(self.models.values()))
+        if not self.models:
+            raise ValueError("No model registered. Call register_model() (e.g. in pre_stage).")
+        raise ValueError("Multiple models registered; override Stage.model_name() to pick one.")
+
+    def _optimizer_for(self, model_name: str):
+        for opt_name, opt in self.optimizers.items():
+            bound = self._optimizer_model.get(opt_name)
+            if bound == model_name or bound is None:
+                return opt
+        raise ValueError("No optimizer registered. Call register_optimizer() (e.g. in pre_stage).")
+
+    # -------------------------------------------------------- checkpointing
+    def enable_checkpointing(self, root: str, resume: bool = False):
+        """Reference pipeline.py:116-137: reuse a valid dir when resuming,
+        rediscover by Slurm job id on requeue, else generate a fresh path
+        agreed across processes via broadcast."""
+        if self.checkpointing_enabled:
+            raise ValueError("Checkpointing already enabled")
+
+        path = None
+        if resume and CheckpointDir(root).is_valid:
+            path = root
+            self.resumed = True
+        elif resume and (slurm_path := find_slurm_checkpoint(root)):
+            path = slurm_path
+            self.resumed = True
+
+        if path is None:
+            path = generate_checkpoint_path(root=root, name=self.name)
+            path = runtime.broadcast_object(path)
+            self.resumed = False
+
+        self.checkpoint_dir = CheckpointDir(path)
+
+    def enable_wandb(
+        self,
+        project: str | None = None,
+        entity: str | None = None,
+        group: str | None = None,
+        tags: list[str] | None = None,
+        startup_timeout: int = 360,
+        **kwargs,
+    ):
+        import wandb as _wandb  # import now to catch a missing install early
+
+        @runtime.root_only
+        def initializer():
+            wandb_set_startup_timeout(startup_timeout)
+            _wandb.init(
+                config=self.config.to_dict(),
+                name=self.name,
+                entity=entity,
+                project=project if project else self.name,
+                group=group,
+                tags=tags,
+                **kwargs,
+            )
+
+        self._wandb_initializer = initializer
+        self.wandb = True
+
+    # -------------------------------------------------------------- metrics
+    def track_reduce(
+        self,
+        name: str,
+        value: Any,
+        step: int | None = None,
+        reduction: Reduction = Reduction.MEAN,
+        dim: list[int] | None = None,
+        reduce_globally: bool = True,
+    ):
+        if name not in self.tracker:
+            self.tracker.register_metric(name, reduction, dim, reduce_globally)
+        self.tracker.track(name, value)
+
+    def track(self, name: str, value: Any, step: int | None = None):
+        if name not in self.tracker:
+            self.tracker.register_metric(name)
+        self.tracker.track(name, value)
+
+    def barrier(self, timeout=None):
+        """All-process barrier with timeout (reference pipeline.py:191-196)."""
+        runtime.barrier("pipeline", timeout if timeout is not None else 600.0)
+
+    # ------------------------------------------------------------ lifecycle
+    def run(self):
+        """Run all registered stages sequentially."""
+        with _RunGuard(self):
+            self._pre_run()
+            for stage in self.stages:
+                self.current_stage = stage
+                stage.run()
+            self._post_run()
+
+    # user hooks (reference pipeline.py:208-215)
+    def pre_run(self):
+        pass
+
+    def post_run(self):
+        pass
+
+    def resume_run(self):
+        pass
+
+    # internals
+    def _init_mesh(self):
+        if self.mesh is None:
+            self.mesh = mesh_lib.create_mesh({mesh_lib.DATA: -1})
+        runtime._cpu_safety_flags()
+
+    def _pre_run(self):
+        if len(self.stages) == 0:
+            raise ValueError("No stages defined. Use append_stage() to add stages to the pipeline.")
+        if not runtime.is_initialized():
+            runtime.init_auto()
+
+        self._init_mesh()
+        if self.root_key is None:
+            self.root_key = jax.random.PRNGKey(int(self.config.get("seed", 0)))
+
+        # prevent checkpoint-dir creation before every process searched for it
+        # (reference pipeline.py:244-246)
+        self.barrier(timeout=600)
+        if self.checkpointing_enabled:
+            self._init_checkpointing()
+
+        if self.wandb:
+            self._wandb_initializer()
+
+        self.barrier(timeout=600)
+        self.start_time = datetime.now()
+
+        add_log_handlers(self.logger)
+        header = "\n" + experiment_header(self.name, str(self.checkpoint_dir) if self.checkpoint_dir else None, self.start_time)
+        self.logger.info(header)
+
+        if self.resumed:
+            self._resume_run()
+
+        diagnostics = general_diagnostics()
+        diagnostics += "\n* MESH:\n"
+        diagnostics += f"    - axes: {dict(self.mesh.shape)}\n"
+        local_desc = f"{runtime.local_device_count()}x {jax.local_devices()[0].device_kind}"
+        devices = runtime.all_gather_object(local_desc)
+        diagnostics += "\n".join(f"    - [Process {i}] {d}" for i, d in enumerate(devices))
+        diagnostics += "\n* CONFIG:\n"
+        diagnostics += "\n".join(f"    {line}" for line in self.config.to_yaml().splitlines())
+        self.logger.info(diagnostics)
+
+        self.pre_run()
+
+    @runtime.root_only
+    def _init_checkpointing(self):
+        if not self.checkpoint_dir.is_valid:
+            self.checkpoint_dir.create()
+            self.checkpoint_dir.save_config(self.config)
+        self.io_redirector = IORedirector(self.checkpoint_dir.log_file)
+        self.io_redirector.install()
+
+    def _resume_run(self):
+        self.logger.info(f"Resuming training from checkpoint: {self.checkpoint_dir}")
+        self.resume_run()
+
+    def _post_run(self):
+        self.stop_time = datetime.now()
+        if self.checkpoint_dir is not None:
+            self.checkpoint_dir.wait_until_finished()
+        self.logger.info(f"Finished training in {self.stop_time - self.start_time} ({self.stop_time})")
+        if self.checkpointing_enabled:
+            self.logger.info(f"Outputs have been saved to {self.checkpoint_dir}")
+        self.post_run()
+
+    def _pre_epoch(self):
+        pass
+
+    def _post_epoch(self):
+        if self.wandb and runtime.is_root():
+            metrics = {name: self.tracker[name][-1] for name in self.tracker if self.tracker[name]}
+            wandb.log(metrics)
+
+    def _cleanup(self, exc_type, exc_value, traceback):
+        """Guaranteed teardown (reference pipeline.py:303-320)."""
+        if exc_type is KeyboardInterrupt:
+            self.logger.info("------- Training interrupted by user -------")
+        elif exc_type is not None:
+            self.logger.error(
+                "------- Training failed with an exception -------", exc_info=(exc_type, exc_value, traceback)
+            )
+
+        if self.wandb and wandb_is_initialized():
+            wandb.finish(exit_code=0 if exc_type is None else 1)
+
+        if self.io_redirector is not None:
+            self.io_redirector.uninstall()
+
+        return False
+
+
+class _RunGuard:
+    def __init__(self, pipeline):
+        self.pipeline = pipeline
+
+    def __enter__(self):
+        pass
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        return self.pipeline._cleanup(exc_type, exc_value, traceback)

@@ -1,0 +1,111 @@
+"""TrainState: the single pytree that flows through the compiled train step.
+
+The reference mutates stateful objects in place — ``nn.Module`` params, torch
+optimizer slots (/root/reference/dmlcloud/stage.py:263-288). Under XLA the step
+is a pure function traced once, so all mutable state is funneled through one
+pytree: params, optimizer state, step counter, PRNG key. ``TrainState.create``
+lays the whole tree out on the mesh according to a sharding policy
+(parallel/mesh.py), which is the moment the reference would have wrapped with
+DDP (pipeline.py:72-74).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .parallel import mesh as mesh_lib
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    #: non-trained mutable collections (e.g. flax ``batch_stats``); the step
+    #: returns updated extras as a third output (stage.py). The TPU analog of
+    #: the reference's SyncBN buffers (pipeline.py:70-71): with the batch
+    #: sharded over ``data``, computing stats inside the jitted step with an
+    #: ``axis_name`` psum gives synchronised statistics for free.
+    extras: Any = None
+    apply_fn: Callable = struct.field(pytree_node=False, default=None)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False, default=None)
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        rng: jax.Array | int = 0,
+        extras: Any = None,
+        mesh: Mesh | None = None,
+        policy: Any = "replicate",
+    ) -> "TrainState":
+        """Build and (if ``mesh`` is given) shard the full train state.
+
+        ``policy`` follows ``parallel.mesh.make_param_policy``: 'replicate'
+        (DDP semantics), 'fsdp' (ZeRO-3), T5X-style rule list, or a callable.
+        Optimizer slots that mirror a param (Adam moments) inherit its
+        sharding; scalar slots are replicated.
+        """
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        opt_state = tx.init(params)
+        state = cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=rng,
+            extras=extras,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+        if mesh is not None:
+            state = jax.device_put(state, state.shardings(mesh, policy))
+        return state
+
+    def shardings(self, mesh: Mesh, policy: Any = "replicate") -> "TrainState":
+        """A TrainState-shaped tree of NamedShardings (for jit in/out_shardings)."""
+        param_sh = mesh_lib.sharding_for(self.params, mesh, policy)
+        opt_sh = _opt_state_shardings(self.opt_state, self.params, param_sh, mesh)
+        rep = NamedSharding(mesh, P())
+        extras_sh = (
+            mesh_lib.sharding_for(self.extras, mesh, policy) if self.extras is not None else None
+        )
+        return self.replace(step=rep, params=param_sh, opt_state=opt_sh, rng=rep, extras=extras_sh)
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+        )
+
+
+def _opt_state_shardings(opt_state: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:
+    """Sharding tree for optimizer state: any leaf whose shape matches a param
+    leaf (Adam mu/nu, momentum) gets that param's sharding; everything else
+    (counts, scalars) is replicated."""
+    rep = NamedSharding(mesh, P())
+    flat_params = {id(p): s for p, s in zip(jax.tree_util.tree_leaves(params),
+                                            jax.tree_util.tree_leaves(param_shardings))}
+    shape_map: dict[tuple, Any] = {}
+    for p, s in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(param_shardings)):
+        shape_map.setdefault((getattr(p, "shape", ()), getattr(p, "dtype", None)), s)
+
+    def leaf_sharding(leaf):
+        key = (getattr(leaf, "shape", ()), getattr(leaf, "dtype", None))
+        if id(leaf) in flat_params:
+            return flat_params[id(leaf)]
+        return shape_map.get(key, rep)
+
+    return jax.tree_util.tree_map(leaf_sharding, opt_state)

@@ -1,0 +1,15 @@
+from . import argparse_ext, config, git, logging, project, seed, slurm, table, tcp, thirdparty, wandb
+
+__all__ = [
+    "argparse_ext",
+    "config",
+    "git",
+    "logging",
+    "project",
+    "seed",
+    "slurm",
+    "table",
+    "tcp",
+    "thirdparty",
+    "wandb",
+]

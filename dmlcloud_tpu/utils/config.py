@@ -1,0 +1,110 @@
+"""Lightweight hierarchical config with YAML round-tripping.
+
+The reference leans on OmegaConf (/root/reference/dmlcloud/pipeline.py:21-27,
+checkpoint.py:105-117). OmegaConf is not a baked dependency here, so the
+framework ships its own minimal equivalent: a dict-like, attribute-accessible,
+YAML-serialisable config container. ``as_config`` accepts ``Config | dict |
+None`` the way the reference pipeline accepts ``OmegaConf | dict | None``, and
+transparently uses OmegaConf objects if the user passes one (duck-typed via
+``to_container``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Iterator, Mapping
+
+import yaml
+
+
+class Config(Mapping):
+    """Nested dict with attribute access: ``cfg.model.lr`` == ``cfg['model']['lr']``."""
+
+    def __init__(self, data: Mapping | None = None):
+        object.__setattr__(self, "_data", {})
+        if data:
+            for k, v in dict(data).items():
+                self[k] = v
+
+    # -- mapping protocol ---------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._data[key]
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        if isinstance(value, Mapping) and not isinstance(value, Config):
+            value = Config(value)
+        self._data[key] = value
+
+    def __delitem__(self, key: str) -> None:
+        del self._data[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._data
+
+    # -- attribute access ---------------------------------------------------
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self._data[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        self[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def setdefault(self, key: str, default: Any = None) -> Any:
+        if key not in self._data:
+            self[key] = default
+        return self._data[key]
+
+    def update(self, other: Mapping) -> None:
+        for k, v in dict(other).items():
+            self[k] = v
+
+    # -- conversion ---------------------------------------------------------
+    def to_dict(self) -> dict:
+        out = {}
+        for k, v in self._data.items():
+            out[k] = v.to_dict() if isinstance(v, Config) else v
+        return out
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(self.to_yaml())
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Config":
+        data = yaml.safe_load(Path(path).read_text())
+        return cls(data or {})
+
+    def __repr__(self) -> str:
+        return f"Config({self.to_dict()!r})"
+
+
+def as_config(obj: Any) -> Config:
+    """Coerce ``Config | dict | OmegaConf | None`` to a Config."""
+    if obj is None:
+        return Config()
+    if isinstance(obj, Config):
+        return obj
+    if isinstance(obj, Mapping):
+        return Config(obj)
+    # OmegaConf duck-typing without importing omegaconf.
+    if hasattr(obj, "_content") or type(obj).__name__ in ("DictConfig",):
+        try:
+            from omegaconf import OmegaConf  # type: ignore
+
+            return Config(OmegaConf.to_container(obj, resolve=True))
+        except Exception:
+            pass
+    raise TypeError(f"cannot convert {type(obj)!r} to Config")

@@ -1,0 +1,207 @@
+"""Logging, stdout/stderr tee, and reproducibility diagnostics.
+
+Capability parity with /root/reference/dmlcloud/util/logging.py:
+``IORedirector`` tee into the checkpoint dir (:18-81), ``DevNullIO`` (:84-90),
+rank-aware log handlers (:93-108), experiment header (:119-128), and the deep
+diagnostics block (:131-173) — with the CUDA/`nvidia-smi` section replaced by
+its TPU equivalent: device kind & count, process topology, default backend,
+libtpu/jaxlib versions, and the mesh shape when one is active.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import sys
+from pathlib import Path
+
+import jax
+
+from . import slurm
+from .git import git_hash
+from .thirdparty import ML_MODULES, is_imported, try_get_version
+
+logger = logging.getLogger("dmlcloud_tpu")
+
+BANNER = r"""
+     _           _                 _      _
+  __| |_ __ ___ | | ___ | ___  _  _| | __ | |_ _ __  _  _
+ / _` | '_ ` _ \| |/ __|/ / _ \| || | |/ _` | __| '_ \| || |
+| (_| | | | | | | | (__| | (_) | || | | (_) | |_| |_) | || |
+ \__,_|_| |_| |_|_|\___|\_\___/ \_,_|_|\__,_|\__| .__/ \_,_|
+                                                |_|   on TPU
+"""
+
+
+class IORedirector:
+    """Tee ``sys.stdout``/``sys.stderr`` into a log file while still writing to
+    the original streams (reference util/logging.py:18-81). Installed root-only
+    once the checkpoint dir exists; uninstall restores the originals."""
+
+    class _Tee(io.TextIOBase):
+        def __init__(self, parent: "IORedirector", stream):
+            self.parent = parent
+            self.stream = stream
+
+        def write(self, s) -> int:
+            n = self.stream.write(s)
+            if self.parent.file is not None:
+                try:
+                    self.parent.file.write(s)
+                except ValueError:  # file already closed
+                    pass
+            return n
+
+        def flush(self) -> None:
+            self.stream.flush()
+            if self.parent.file is not None:
+                try:
+                    self.parent.file.flush()
+                except ValueError:
+                    pass
+
+        @property
+        def encoding(self):
+            return getattr(self.stream, "encoding", "utf-8")
+
+        def isatty(self) -> bool:
+            return self.stream.isatty()
+
+        def fileno(self) -> int:
+            return self.stream.fileno()
+
+    def __init__(self, log_file: str | Path):
+        self.log_path = Path(log_file)
+        self.file = None
+        self._orig_stdout = None
+        self._orig_stderr = None
+
+    def install(self) -> None:
+        if self.file is not None:
+            return
+        self.file = open(self.log_path, "a", buffering=1)
+        self._orig_stdout = sys.stdout
+        self._orig_stderr = sys.stderr
+        sys.stdout = IORedirector._Tee(self, self._orig_stdout)
+        sys.stderr = IORedirector._Tee(self, self._orig_stderr)
+
+    def uninstall(self) -> None:
+        if self.file is None:
+            return
+        sys.stdout = self._orig_stdout
+        sys.stderr = self._orig_stderr
+        self.file.close()
+        self.file = None
+
+
+class DevNullIO(io.TextIOBase):
+    """A sink that swallows writes (reference util/logging.py:84-90)."""
+
+    def write(self, s) -> int:
+        return len(s)
+
+    def flush(self) -> None:
+        pass
+
+
+def add_log_handlers(logger_: logging.Logger | None = None, is_root: bool | None = None) -> None:
+    """Attach the rank-aware handlers: root logs at INFO, non-root at WARNING;
+    records below WARNING go to stdout, WARNING+ to stderr (reference
+    util/logging.py:93-108)."""
+    logger_ = logger_ or logger
+    # Rebuild rather than keep handlers: existing ones may be bound to a
+    # stream that no longer exists (redirected/captured stdout from an
+    # earlier run in the same process).
+    for h in list(logger_.handlers):
+        logger_.removeHandler(h)
+    if is_root is None:
+        from ..parallel.runtime import is_root as _is_root
+
+        is_root = _is_root()
+    logger_.setLevel(logging.INFO if is_root else logging.WARNING)
+
+    stdout_handler = logging.StreamHandler(sys.stdout)
+    stdout_handler.setLevel(logging.DEBUG)
+    stdout_handler.addFilter(lambda rec: rec.levelno < logging.WARNING)
+    stdout_handler.setFormatter(logging.Formatter("%(message)s"))
+    logger_.addHandler(stdout_handler)
+
+    stderr_handler = logging.StreamHandler(sys.stderr)
+    stderr_handler.setLevel(logging.WARNING)
+    stderr_handler.setFormatter(logging.Formatter("%(levelname)s: %(message)s"))
+    logger_.addHandler(stderr_handler)
+
+
+def flush_log_handlers(logger_: logging.Logger | None = None) -> None:
+    for h in (logger_ or logger).handlers:
+        h.flush()
+
+
+def experiment_header(name: str | None, checkpoint_path: str | None, start_time) -> str:
+    """Banner + run identity block (reference util/logging.py:119-128)."""
+    lines = [BANNER]
+    lines.append(f"Experiment: {name if name else '[unnamed]'}")
+    lines.append(f"Checkpoint: {checkpoint_path if checkpoint_path else '[disabled]'}")
+    lines.append(f"Start time: {start_time}")
+    return "\n".join(lines)
+
+
+def general_diagnostics() -> str:
+    """The reproducibility block logged at run start (reference
+    util/logging.py:131-173) — argv, cwd, host, user, git state, Python env,
+    then TPU topology in place of `nvidia-smi`, imported ML module versions,
+    and the Slurm environment dump."""
+    import getpass
+    import socket
+
+    lines = []
+    lines.append("* GENERAL:")
+    lines.append(f"    - argv: {sys.argv}")
+    lines.append(f"    - cwd: {os.getcwd()}")
+    try:
+        lines.append(f"    - host: {socket.gethostname()}")
+        lines.append(f"    - user: {getpass.getuser()}")
+    except Exception:
+        pass
+    h = git_hash()
+    if h:
+        lines.append(f"    - git-hash: {h}")
+    conda = os.environ.get("CONDA_DEFAULT_ENV")
+    if conda:
+        lines.append(f"    - conda-env: {conda}")
+    lines.append(f"    - sys-prefix: {sys.prefix}")
+    lines.append(f"    - python: {sys.version.split()[0]}")
+
+    lines.append("* ACCELERATORS:")
+    try:
+        devices = jax.devices()
+        lines.append(f"    - backend: {jax.default_backend()}")
+        lines.append(f"    - process: {jax.process_index()}/{jax.process_count()}")
+        lines.append(f"    - devices: {len(devices)} global, {jax.local_device_count()} local")
+        kinds = sorted({d.device_kind for d in devices})
+        for kind in kinds:
+            n = sum(1 for d in devices if d.device_kind == kind)
+            lines.append(f"    - {n}x {kind}")
+        coords = getattr(devices[0], "coords", None)
+        if coords is not None:
+            lines.append(f"    - device 0 coords: {coords}")
+    except Exception as e:  # diagnostics must never kill a run
+        lines.append(f"    - <error probing devices: {e}>")
+
+    lines.append("* VERSIONS:")
+    for mod in ML_MODULES:
+        if is_imported(mod):
+            v = try_get_version(mod)
+            if v:
+                lines.append(f"    - {mod}: {v}")
+    libtpu = try_get_version("libtpu")
+    if libtpu:
+        lines.append(f"    - libtpu: {libtpu}")
+
+    if slurm.slurm_available():
+        lines.append("* SLURM:")
+        for key in sorted(k for k in os.environ if k.startswith("SLURM")):
+            lines.append(f"    - {key}: {os.environ[key]}")
+
+    return "\n".join(lines)

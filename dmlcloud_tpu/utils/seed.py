@@ -1,0 +1,61 @@
+"""Seeding & determinism, re-designed for JAX's functional PRNG.
+
+The reference seeds three global RNGs (/root/reference/dmlcloud/util/seed.py:7-15).
+JAX has no global RNG for traced code: randomness is an explicit ``PRNGKey``
+threaded through the step function. The mapping implemented here:
+
+- ``seed_all(seed)`` seeds the *host-side* RNGs (numpy, random — used by data
+  sharding shuffles) exactly like the reference, AND returns a root
+  ``jax.random.PRNGKey(seed)`` for traced code. Pass ``None`` to draw a fresh
+  seed (broadcast from process 0 so all hosts agree).
+- ``worker_key(key)`` folds the process index into a key so each host gets a
+  distinct-but-deterministic stream (the analog of per-rank seed offsets).
+- ``enable_determinism()`` turns on the XLA/JAX flags that make runs bitwise
+  reproducible (deterministic reductions; partitionable threefry so sharded
+  random bits don't depend on mesh layout).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def seed_all(seed: int | None = None) -> jax.Array:
+    """Seed host RNGs and return the root PRNG key for traced code.
+
+    With ``seed=None``, process 0 draws a seed and broadcasts it so every host
+    derives the same root key.
+    """
+    if seed is None:
+        seed = int(np.random.SeedSequence().entropy % (2**31))
+        if jax.process_count() > 1:
+            from ..parallel.runtime import broadcast_object
+
+            seed = broadcast_object(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return jax.random.PRNGKey(seed)
+
+
+def worker_key(key: jax.Array, process_index: int | None = None) -> jax.Array:
+    """A per-host key: fold the process index into the root key."""
+    if process_index is None:
+        process_index = jax.process_index()
+    return jax.random.fold_in(key, process_index)
+
+
+def step_key(key: jax.Array, step: int) -> jax.Array:
+    """A per-step key, deterministic in (root key, step)."""
+    return jax.random.fold_in(key, step)
+
+
+def enable_determinism() -> None:
+    """Make runs bitwise-reproducible across restarts (same topology)."""
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        jax.config.update("jax_default_matmul_precision", "highest")
+    except Exception:
+        pass

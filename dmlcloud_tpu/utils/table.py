@@ -1,0 +1,89 @@
+"""Minimal live console table for per-epoch progress.
+
+The reference renders epochs through the third-party ``progress_table``
+package (/root/reference/dmlcloud/stage.py:147,188-205). That dependency isn't
+assumed here; this is a self-contained equivalent with the subset of the API
+the Stage layer needs: named columns, cell assignment, one printed row per
+epoch, and a close that draws the bottom border. Output is plain ASCII so it
+stays readable in ``log.txt`` tees and Slurm output files.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable
+
+
+class ProgressTable:
+    def __init__(self, file=None, min_width: int = 10):
+        self.file = file or sys.stdout
+        self.min_width = min_width
+        self.columns: list[str] = []
+        self.widths: dict[str, int] = {}
+        self.formatters: dict[str, Callable[[Any], str]] = {}
+        self.row: dict[str, Any] = {}
+        self._header_printed = False
+        self._closed = False
+
+    def add_column(self, name: str, width: int | None = None, formatter: Callable[[Any], str] | None = None) -> None:
+        if self._header_printed:
+            raise RuntimeError("cannot add columns after the first row")
+        if name in self.columns:
+            return
+        self.columns.append(name)
+        self.widths[name] = max(width or 0, len(name), self.min_width)
+        if formatter:
+            self.formatters[name] = formatter
+
+    def __setitem__(self, name: str, value: Any) -> None:
+        if name not in self.columns:
+            self.add_column(name)
+        self.row[name] = value
+
+    def update(self, name: str, value: Any) -> None:
+        self[name] = value
+
+    def _fmt(self, name: str, value: Any) -> str:
+        if value is None:
+            return ""
+        if name in self.formatters:
+            return self.formatters[name](value)
+        if isinstance(value, float):
+            return f"{value:.5g}"
+        try:
+            import numpy as np
+
+            if isinstance(value, np.ndarray) and value.ndim == 0:
+                return f"{float(value):.5g}"
+        except Exception:
+            pass
+        return str(value)
+
+    def _border(self, left: str, mid: str, right: str) -> str:
+        return left + mid.join("─" * (self.widths[c] + 2) for c in self.columns) + right
+
+    def _print(self, s: str) -> None:
+        print(s, file=self.file, flush=True)
+
+    def _print_header(self) -> None:
+        self._print(self._border("┌", "┬", "┐"))
+        cells = " │ ".join(f"{c:^{self.widths[c]}}" for c in self.columns)
+        self._print(f"│ {cells} │")
+        self._print(self._border("├", "┼", "┤"))
+        self._header_printed = True
+
+    def next_row(self) -> None:
+        if not self.columns:
+            return
+        if not self._header_printed:
+            self._print_header()
+        cells = " │ ".join(f"{self._fmt(c, self.row.get(c)):>{self.widths[c]}}" for c in self.columns)
+        self._print(f"│ {cells} │")
+        self.row = {}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        if self._header_printed:
+            self._print(self._border("└", "┴", "┘"))
+        self._closed = True

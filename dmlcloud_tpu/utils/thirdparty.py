@@ -1,0 +1,47 @@
+"""Third-party module probing for the diagnostics block.
+
+Parity with /root/reference/dmlcloud/util/thirdparty.py:7-36, with the module
+list re-centred on the JAX/TPU ecosystem.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from types import ModuleType
+
+ML_MODULES = [
+    "jax",
+    "jaxlib",
+    "flax",
+    "optax",
+    "orbax.checkpoint",
+    "chex",
+    "haiku",
+    "einops",
+    "numpy",
+    "torch",
+    "transformers",
+    "xarray",
+    "wandb",
+    "pandas",
+    "scipy",
+]
+
+
+def is_imported(name: str) -> bool:
+    return name in sys.modules
+
+
+def try_import(name: str) -> ModuleType | None:
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def try_get_version(name: str) -> str | None:
+    mod = sys.modules.get(name)
+    if mod is None:
+        return None
+    return getattr(mod, "__version__", None)

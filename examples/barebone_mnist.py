@@ -1,0 +1,82 @@
+"""Barebone MNIST: a plain ``Stage`` with a hand-written jitted train loop —
+parity with /root/reference/examples/barebone_mnist.py, which shows the
+framework's lower-level API (no TrainValStage, manual epoch loop and metric
+tracking).
+
+Run: python examples/barebone_mnist.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.metrics import Reduction
+from dmlcloud_tpu.models.cnn import MnistCNN
+from dmlcloud_tpu.parallel import init_auto, make_global_batch
+from dmlcloud_tpu.train_state import TrainState
+
+# reuse the example's hermetic data loader
+from mnist import load_mnist, batches
+
+
+class BareboneMnistStage(dml.Stage):
+    def pre_stage(self):
+        self.tr_x, self.tr_y, self.te_x, self.te_y = load_mnist()
+
+        model = MnistCNN()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+        self.state = TrainState.create(
+            apply_fn=model.apply,
+            params=params,
+            tx=optax.adam(1e-3),
+            mesh=self.mesh,
+            policy="replicate",
+        )
+
+        def train_step(state, batch):
+            def loss_fn(params):
+                logits = state.apply_fn(params, batch["image"])
+                return optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads), loss
+
+        def val_step(state, batch):
+            logits = state.apply_fn(state.params, batch["image"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
+            acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+            return loss, acc
+
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._val_step = jax.jit(val_step)
+
+    def run_epoch(self):
+        for batch in batches(self.tr_x, self.tr_y, 32):
+            batch = make_global_batch(batch, self.mesh)
+            self.state, loss = self._train_step(self.state, batch)
+            self.track_reduce("train/loss", loss)
+            self.track_reduce("num_batches", 1, reduction=Reduction.SUM)
+
+        for batch in batches(self.te_x, self.te_y, 32):
+            batch = make_global_batch(batch, self.mesh)
+            loss, acc = self._val_step(self.state, batch)
+            self.track_reduce("val/loss", loss)
+            self.track_reduce("val/accuracy", acc)
+
+    def table_columns(self):
+        cols = super().table_columns()
+        cols += ["train/loss", "val/loss", "val/accuracy"]
+        return cols
+
+
+def main():
+    init_auto(verbose=True)
+    pipeline = dml.TrainingPipeline(name="barebone-mnist")
+    pipeline.append_stage(BareboneMnistStage(), max_epochs=3)
+    pipeline.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Framework-idiomatic MNIST training — parity with the reference example
+(/root/reference/examples/mnist.py): auto-init, checkpointing, sharded data,
+a TrainValStage subclass, and per-epoch metrics in a live table.
+
+Data: uses torchvision's MNIST if it is already on disk (downloads are gated
+behind ``root_first`` exactly like the reference example, mnist.py:18-25);
+otherwise falls back to a deterministic synthetic digit set so the example
+runs hermetically.
+
+Run: python examples/mnist.py [--epochs 3] [--batch-size 32]
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.data import ShardedSequenceDataset
+from dmlcloud_tpu.models.cnn import MnistCNN
+from dmlcloud_tpu.parallel import init_auto, root_first
+
+
+def load_mnist():
+    """(train_images, train_labels, test_images, test_labels) as numpy, NHWC in [0,1]."""
+    try:
+        with root_first():  # only the root downloads; others wait (reference mnist.py:18-25)
+            from torchvision.datasets import MNIST
+
+            train = MNIST(root="./data", train=True, download=True)
+            test = MNIST(root="./data", train=False, download=True)
+        tr_x = train.data.numpy()[..., None].astype(np.float32) / 255.0
+        te_x = test.data.numpy()[..., None].astype(np.float32) / 255.0
+        return tr_x, train.targets.numpy(), te_x, test.targets.numpy()
+    except Exception:
+        rng = np.random.RandomState(0)
+        n_tr, n_te = 4096, 512
+        x = rng.rand(n_tr + n_te, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=n_tr + n_te)
+        # stamp a class-dependent pattern so the task is learnable
+        for i, label in enumerate(y):
+            x[i, label * 2 : label * 2 + 4, :8, 0] += 2.0
+        return x[:n_tr], y[:n_tr], x[n_tr:], y[n_tr:]
+
+
+def batches(images, labels, batch_size):
+    for i in range(0, len(images) - batch_size + 1, batch_size):
+        yield {"image": images[i : i + batch_size], "label": labels[i : i + batch_size]}
+
+
+class MnistStage(dml.TrainValStage):
+    def pre_stage(self):
+        cfg = self.config
+        tr_x, tr_y, te_x, te_y = load_mnist()
+
+        # shard the sample indices across processes; each process batches its shard
+        train_idx = ShardedSequenceDataset(list(range(len(tr_x))), shuffle=True)
+        val_idx = ShardedSequenceDataset(list(range(len(te_x))))
+        bs = cfg.batch_size
+
+        class Loader:
+            def __init__(self, idx_ds, x, y):
+                self.idx_ds, self.x, self.y = idx_ds, x, y
+
+            def set_epoch(self, epoch):
+                self.idx_ds.set_epoch(epoch)
+
+            def __iter__(self):
+                idx = np.fromiter(self.idx_ds, dtype=np.int64)
+                for i in range(0, len(idx) - bs + 1, bs):
+                    sel = idx[i : i + bs]
+                    yield {"image": self.x[sel], "label": self.y[sel]}
+
+            def __len__(self):
+                return len(self.idx_ds) // bs
+
+        self.pipeline.register_dataset("train", Loader(train_idx, tr_x, tr_y))
+        self.pipeline.register_dataset("val", Loader(val_idx, te_x, te_y))
+
+        model = MnistCNN()
+        self.pipeline.register_model(
+            "cnn",
+            model,
+            init_args=(jnp.zeros((1, 28, 28, 1)),),
+            sharding="replicate",
+        )
+        schedule = optax.cosine_decay_schedule(cfg.lr, decay_steps=1000)
+        self.pipeline.register_optimizer("adam", optax.adam(schedule), scheduler=schedule)
+
+    def step(self, state, batch):
+        logits = state.apply_fn({"params": state.params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"accuracy": accuracy}
+
+    def table_columns(self):
+        cols = super().table_columns()
+        cols.insert(3, {"name": "[Val] Acc.", "metric": "val/accuracy"})
+        return cols
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--checkpoint-dir", type=str, default=None)
+    args = parser.parse_args()
+
+    init_auto(verbose=True)
+
+    config = {"batch_size": args.batch_size, "lr": args.lr, "seed": 42}
+    pipeline = dml.TrainingPipeline(config, name="mnist")
+    if args.checkpoint_dir:
+        pipeline.enable_checkpointing(args.checkpoint_dir)
+    pipeline.append_stage(MnistStage(), max_epochs=args.epochs)
+    pipeline.run()
+
+
+if __name__ == "__main__":
+    main()

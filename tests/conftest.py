@@ -1,0 +1,41 @@
+"""Test fixtures: a virtual 8-device CPU mesh in one process.
+
+The reference fakes a cluster with a world-size-1 HashStore process group
+(/root/reference/test/conftest.py:6-10). The TPU build goes further: XLA's
+host-platform device count gives *real* multi-device pjit/psum execution on
+CPU (SURVEY.md §4 testing blueprint) — sharding bugs show up for real.
+
+Must run before any test imports trigger backend initialisation.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Serial dispatch: concurrent collective programs starve XLA:CPU's rendezvous
+# on few-core CI machines (see pipeline._init_mesh).
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+import pytest  # noqa: E402
+
+from dmlcloud_tpu.parallel import runtime  # noqa: E402
+
+
+@pytest.fixture
+def single_runtime():
+    """Single-process runtime (the reference's dummy process group analog)."""
+    runtime.init_single()
+    yield
+    runtime.deinitialize()
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-device data-parallel mesh on the forced CPU devices."""
+    from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+    assert len(jax.devices()) == 8, "conftest must run before backend init"
+    return mesh_lib.create_mesh({"data": -1})

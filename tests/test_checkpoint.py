@@ -1,0 +1,72 @@
+"""Checkpoint dir contract, Slurm rediscovery, config round-trip, and Orbax
+tensor-state save/restore (the capability the reference leaves to user hooks)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.checkpoint import (
+    CheckpointDir,
+    find_slurm_checkpoint,
+    generate_checkpoint_path,
+    generate_id,
+)
+
+
+def test_generate_id_urlsafe():
+    i = generate_id(12)
+    assert len(i) == 12
+    assert i.isalnum()
+
+
+def test_generate_checkpoint_path(tmp_path):
+    p = generate_checkpoint_path(tmp_path, "exp/1")
+    assert p.parent == tmp_path
+    assert p.name.startswith("exp_1-")  # slash sanitized
+    assert p != generate_checkpoint_path(tmp_path, "exp/1")
+
+
+def test_create_and_validity(tmp_path):
+    ckpt = CheckpointDir(tmp_path / "run")
+    assert not ckpt.is_valid
+    ckpt.create()
+    assert ckpt.is_valid
+    assert ckpt.log_file.exists()
+    with pytest.raises(RuntimeError):
+        ckpt.create()
+
+
+def test_config_roundtrip(tmp_path):
+    ckpt = CheckpointDir(tmp_path / "run")
+    ckpt.create()
+    ckpt.save_config({"lr": 0.1, "model": {"depth": 3}})
+    cfg = ckpt.load_config()
+    assert cfg.lr == 0.1
+    assert cfg.model.depth == 3
+
+
+def test_slurm_rediscovery(tmp_path, monkeypatch):
+    monkeypatch.setenv("SLURM_JOB_ID", "4242")
+    ckpt = CheckpointDir(tmp_path / "run-a")
+    ckpt.create()
+    assert ckpt.slurm_job_id == "4242"
+
+    found = find_slurm_checkpoint(tmp_path)
+    assert found == ckpt.path
+
+    monkeypatch.setenv("SLURM_JOB_ID", "9999")
+    assert find_slurm_checkpoint(tmp_path) is None
+
+
+def test_orbax_state_roundtrip(tmp_path, single_runtime):
+    ckpt = CheckpointDir(tmp_path / "run")
+    ckpt.create()
+    state = {"w": jnp.arange(8.0), "step": jnp.int32(5)}
+    ckpt.save_state(0, state)
+    ckpt.wait_until_finished()
+    assert ckpt.latest_step() == 0
+
+    restored = ckpt.restore_state(template=state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
+    assert int(restored["step"]) == 5
+    ckpt.close()

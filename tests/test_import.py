@@ -1,0 +1,17 @@
+"""Packaging sanity (reference test/test_import.py:6-16)."""
+
+import dmlcloud_tpu
+
+
+def test_import():
+    assert dmlcloud_tpu is not None
+
+
+def test_version():
+    assert isinstance(dmlcloud_tpu.__version__, str)
+    assert len(dmlcloud_tpu.__version__.split(".")) >= 2
+
+
+def test_public_api():
+    for sym in ("TrainingPipeline", "Stage", "TrainValStage", "MetricTracker", "Reduction", "CheckpointDir"):
+        assert hasattr(dmlcloud_tpu, sym)

@@ -1,0 +1,183 @@
+"""Metric engine parity suite, modeled on the reference's
+test/test_metrics.py: local-vs-global equivalence at world 1, partial-dim
+shapes, serialization round-trip, empty -> None, tracker epoch bookkeeping,
+double-track errors, prefix reduction, state_dict."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.metrics import MetricReducer, MetricTracker, Reduction, reduce_tensor
+
+
+class TestReduceTensor:
+    def test_mean_all_dims(self):
+        t = np.arange(12.0).reshape(3, 4)
+        assert reduce_tensor(t, Reduction.MEAN) == pytest.approx(5.5)
+
+    def test_partial_dims(self):
+        t = np.arange(24.0).reshape(2, 3, 4)
+        out = reduce_tensor(t, Reduction.SUM, dim=[0, 2])
+        assert out.shape == (3,)
+        np.testing.assert_array_equal(out, t.sum(axis=(0, 2)))
+
+    def test_min_max(self):
+        t = np.array([3.0, -1.0, 7.0])
+        assert reduce_tensor(t, Reduction.MIN) == -1.0
+        assert reduce_tensor(t, Reduction.MAX) == 7.0
+
+
+class TestMetricReducer:
+    def test_local_global_equal_world1(self, single_runtime):
+        r = MetricReducer(Reduction.MEAN)
+        for v in (1.0, 2.0, 3.0):
+            r.append(v)
+        np.testing.assert_allclose(r.reduce_locally(), 2.0)
+        np.testing.assert_allclose(r.reduce_globally(), 2.0)
+
+    def test_jax_values_accepted(self, single_runtime):
+        r = MetricReducer(Reduction.SUM)
+        r.append(jnp.float32(1.5))
+        r.append(jnp.float32(2.5))
+        assert float(r.reduce_globally()) == 4.0
+
+    def test_dim_reduction_shapes(self, single_runtime):
+        r = MetricReducer(Reduction.MEAN, dim=0)
+        r.append(np.ones((5, 3)))
+        r.append(np.zeros((5, 3)))
+        out = r.reduce_locally()
+        assert out.shape == (3,)
+        np.testing.assert_allclose(out, 0.5)
+
+    def test_empty_returns_none(self, single_runtime):
+        r = MetricReducer(Reduction.MEAN)
+        assert r.reduce_locally() is None
+        assert r.reduce_globally() is None
+
+    def test_state_dict_roundtrip(self, single_runtime):
+        r = MetricReducer(Reduction.MAX, dim=[1])
+        r.append(np.arange(6.0).reshape(2, 3))
+        state = r.state_dict()
+        r2 = MetricReducer()
+        r2.load_state_dict(state)
+        assert r2.reduction == Reduction.MAX
+        assert r2.dim == [1]
+        np.testing.assert_array_equal(r2.reduce_locally(), r.reduce_locally())
+
+    def test_list_protocol(self):
+        r = MetricReducer()
+        r += 1.0
+        r.extend([2.0, 3.0])
+        assert len(r) == 3
+        del r[0]
+        assert len(r) == 2
+        r[0] = 9.0
+        assert r[0] == 9.0
+        r.clear()
+        assert len(r) == 0
+
+    def test_invalid_reduction(self):
+        with pytest.raises(ValueError):
+            MetricReducer("bogus")
+
+
+class TestMetricTracker:
+    def test_register_and_track(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("loss", Reduction.MEAN)
+        t.track("loss", 2.0)
+        t.track("loss", 4.0)
+        t.next_epoch()
+        assert t.epoch == 2
+        assert t["loss"] == [pytest.approx(3.0)]
+
+    def test_unknown_metric_raises(self):
+        t = MetricTracker()
+        with pytest.raises(ValueError):
+            t.track("nope", 1.0)
+        with pytest.raises(ValueError):
+            t["nope"]
+
+    def test_double_register_raises(self):
+        t = MetricTracker()
+        t.register_metric("m")
+        with pytest.raises(ValueError):
+            t.register_metric("m")
+
+    def test_dim_without_reduction_raises(self):
+        t = MetricTracker()
+        with pytest.raises(ValueError):
+            t.register_metric("m", dim=[0])
+
+    def test_manual_metric_once_per_epoch(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("lr")
+        t.track("lr", 0.1)
+        with pytest.raises(ValueError):
+            t.track("lr", 0.2)
+        t.next_epoch()
+        t.track("lr", 0.2)
+        assert t["lr"] == [0.1]
+
+    def test_late_registration_pads_history(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("a", Reduction.MEAN)
+        t.track("a", 1.0)
+        t.next_epoch()
+        t.register_metric("b", Reduction.MEAN)
+        t.track("b", 5.0)
+        t.next_epoch()
+        assert t["b"] == [None, pytest.approx(5.0)]
+
+    def test_untracked_reduced_metric_appends_none(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("loss", Reduction.MEAN)
+        t.next_epoch()
+        assert t["loss"] == [None]
+
+    def test_prefix_reduction(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("train/loss", Reduction.MEAN)
+        t.register_metric("val/loss", Reduction.MEAN)
+        t.track("train/loss", 1.0)
+        t.track("val/loss", 2.0)
+        t.reduce_all(prefix="train/")
+        assert t.has_value("train/loss")
+        assert not t.has_value("val/loss")
+        # strict double-reduce raises
+        with pytest.raises(ValueError):
+            t.reduce_all(prefix="train/")
+        t.next_epoch()
+        assert t["train/loss"] == [pytest.approx(1.0)]
+        assert t["val/loss"] == [pytest.approx(2.0)]
+
+    def test_current_value_and_is_reduced(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("loss", Reduction.MEAN)
+        t.register_metric("note")
+        assert t.is_reduced_metric("loss")
+        assert not t.is_reduced_metric("note")
+        t.track("loss", 1.0)
+        assert t.current_value("loss") is None
+        t.reduce_all()
+        assert t.current_value("loss") == pytest.approx(1.0)
+
+    def test_state_dict_roundtrip(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("loss", Reduction.MEAN)
+        t.track("loss", 1.0)
+        t.next_epoch()
+        t.track("loss", 3.0)
+        state = t.state_dict()
+
+        t2 = MetricTracker()
+        t2.load_state_dict(state)
+        assert t2.epoch == 2
+        t2.next_epoch()
+        assert t2["loss"][0] == pytest.approx(1.0)
+        assert t2["loss"][1] == pytest.approx(3.0)
+
+    def test_str(self):
+        t = MetricTracker()
+        t.register_metric("x")
+        assert "x" in str(t)

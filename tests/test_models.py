@@ -1,0 +1,143 @@
+"""Model zoo: shape/dtype checks, a real sharded train step for the decoder
+LM under dp+fsdp+tp rules, and ring-attention parity inside the full model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dmlcloud_tpu.models.cnn import MnistCNN
+from dmlcloud_tpu.models.resnet import ResNet18, ResNet50
+from dmlcloud_tpu.models.transformer import (
+    DecoderLM,
+    TransformerConfig,
+    lm_loss,
+    llama_partition_rules,
+)
+from dmlcloud_tpu.parallel import mesh as mesh_lib
+from dmlcloud_tpu.train_state import TrainState
+
+
+SMALL = TransformerConfig(
+    vocab_size=256,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=16,
+    hidden_dim=64,
+    mlp_dim=128,
+    max_seq_len=64,
+    dtype=jnp.float32,
+)
+
+
+def test_mnist_cnn_shapes():
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 28, 28, 1)))
+    out = model.apply(params, jnp.zeros((2, 28, 28, 1)))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_forward():
+    model = ResNet18(num_classes=10, dtype=jnp.float32)
+    vars_ = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert "batch_stats" in vars_
+    out = model.apply(vars_, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_param_count():
+    model = ResNet50(num_classes=1000, dtype=jnp.float32)
+    vars_ = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)), train=False)
+    )
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(vars_["params"]))
+    assert 25.0e6 < n < 26.0e6  # ResNet-50 is ~25.6M params
+
+
+def test_decoder_lm_forward_and_loss():
+    model = DecoderLM(SMALL)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, SMALL.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 32, SMALL.vocab_size)
+    assert logits.dtype == jnp.float32
+    loss = lm_loss(logits, tokens)
+    assert np.isfinite(float(loss))
+    assert float(loss) == pytest.approx(np.log(SMALL.vocab_size), rel=0.2)
+
+
+def test_decoder_causality():
+    """Changing a future token must not affect earlier logits."""
+    model = DecoderLM(SMALL)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (1, 16), 0, SMALL.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    logits_a = model.apply(params, tokens)
+    tokens_b = tokens.at[0, -1].set((tokens[0, -1] + 1) % SMALL.vocab_size)
+    logits_b = model.apply(params, tokens_b)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :-1]), np.asarray(logits_b[0, :-1]), atol=1e-5
+    )
+
+
+def test_decoder_sharded_train_step_dp_fsdp_tp():
+    """Full dp+fsdp+tp train step on a 2x2x2 mesh: compiles, runs, loss drops."""
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "model": 2})
+    model = DecoderLM(SMALL)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 32), 0, SMALL.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens[:1])
+
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=optax.adam(1e-2),
+        mesh=mesh,
+        policy=llama_partition_rules(),
+    )
+    # param shardings actually use the model axis somewhere
+    specs = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding.spec, state.params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    assert any("model" in str(spec) for spec in specs)
+
+    batch = mesh_lib.make_global_batch(tokens, mesh)
+
+    @jax.jit
+    def train_step(state, batch):
+        def loss_fn(params):
+            return lm_loss(state.apply_fn(params, batch), batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), loss
+
+    losses = []
+    for _ in range(5):
+        state, loss = train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_decoder_ring_attention_matches_dot():
+    """The full model with ring attention over the seq axis == dot attention."""
+    mesh = mesh_lib.create_mesh({"data": 2, "seq": 4})
+    cfg_ring = TransformerConfig(
+        **{**SMALL.__dict__, "attn_impl": "ring", "mesh": mesh}
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, SMALL.vocab_size)
+
+    params = DecoderLM(SMALL).init(jax.random.PRNGKey(1), tokens)
+    logits_dot = DecoderLM(SMALL).apply(params, tokens)
+    logits_ring = DecoderLM(cfg_ring).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits_dot), np.asarray(logits_ring), atol=2e-4, rtol=2e-4)
+
+
+def test_decoder_flash_attention_matches_dot():
+    cfg_flash = TransformerConfig(**{**SMALL.__dict__, "attn_impl": "flash"})
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, SMALL.vocab_size)
+    params = DecoderLM(SMALL).init(jax.random.PRNGKey(1), tokens)
+    logits_dot = DecoderLM(SMALL).apply(params, tokens)
+    logits_flash = DecoderLM(cfg_flash).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits_dot), np.asarray(logits_flash), atol=2e-4, rtol=2e-4)

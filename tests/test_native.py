@@ -1,0 +1,49 @@
+"""Native C++ interleave kernel: correctness vs the numpy fallback, and the
+end-to-end interleave_batches path using it (skipped when the .so isn't built;
+CI builds it via native/build.sh)."""
+
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.native import interleave as native
+
+
+requires_native = pytest.mark.skipif(not native.available(), reason="libdmltpu.so not built")
+
+
+@requires_native
+def test_native_matches_python():
+    n, bs = 4, 16
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(bs, 5).astype(np.float32) for _ in range(n)]
+    s = bs // n
+
+    mem = np.empty((n, bs, 5), np.float32)
+    native.interleave_into(mem, batches, s)
+
+    ref = np.empty_like(mem)
+    for i in range(n):
+        for j in range(n):
+            ref[i, j * s : (j + 1) * s] = batches[j][i * s : (i + 1) * s]
+    np.testing.assert_array_equal(mem, ref)
+
+
+@requires_native
+def test_native_1d_batches():
+    n = 2
+    batches = [np.arange(4, dtype=np.int64), np.arange(4, 8, dtype=np.int64)]
+    mem = np.empty((n, 4), np.int64)
+    native.interleave_into(mem, batches, 2)
+    np.testing.assert_array_equal(mem[0], [0, 1, 4, 5])
+    np.testing.assert_array_equal(mem[1], [2, 3, 6, 7])
+
+
+@requires_native
+def test_interleave_batches_uses_native_path():
+    from dmlcloud_tpu.data import interleave_batches
+
+    batches = [np.random.RandomState(i).randn(8, 4).astype(np.float32) for i in range(4)]
+    out = [b.copy() for b in interleave_batches(iter(batches), 4)]
+    all_in = np.sort(np.concatenate(batches).ravel())
+    all_out = np.sort(np.concatenate(out).ravel())
+    np.testing.assert_array_equal(all_in, all_out)

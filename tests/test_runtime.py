@@ -1,0 +1,86 @@
+"""L1 runtime: accessors, root helpers, object collectives (single process),
+init ladder detection. Multi-process behavior is exercised via the KV-store
+code paths only when a coordination service exists; here world_size==1
+degenerates exactly like the reference's dummy process group."""
+
+import os
+
+import pytest
+
+from dmlcloud_tpu.parallel import runtime
+from dmlcloud_tpu.utils import slurm
+
+
+def test_init_single(single_runtime):
+    assert runtime.is_initialized()
+    assert runtime.rank() == 0
+    assert runtime.world_size() == 1
+    assert runtime.local_rank() == 0
+    assert runtime.local_world_size() == 1
+    assert runtime.is_root()
+
+
+def test_init_auto_falls_back_to_single(single_runtime):
+    runtime.deinitialize()
+    backend = runtime.init_auto()
+    assert backend == "single"
+
+
+def test_root_only(single_runtime):
+    calls = []
+
+    @runtime.root_only
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    assert fn(21) == 42
+    assert calls == [21]
+
+
+def test_root_first(single_runtime):
+    with runtime.root_first():
+        pass  # single process: no deadlock, no error
+
+
+def test_object_collectives_single(single_runtime):
+    assert runtime.broadcast_object({"a": 1}) == {"a": 1}
+    assert runtime.all_gather_object(7) == [7]
+    assert runtime.gather_object("x") == ["x"]
+
+
+def test_barrier_single_noop(single_runtime):
+    runtime.barrier("test", timeout=1)
+
+
+def test_device_accessors(single_runtime):
+    assert runtime.device_count() == 8
+    assert runtime.local_device_count() == 8
+
+
+def test_slurm_detection(monkeypatch):
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("SLURM_NODEID", "1")
+    monkeypatch.setenv("SLURM_STEP_TASKS_PER_NODE", "4(x2)")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "node[017-018]")
+    assert runtime.has_slurm()
+    assert slurm.slurm_rank() == 3
+    assert slurm.slurm_world_size() == 8
+    assert slurm.slurm_tasks_per_node() == 4
+    assert slurm.slurm_head_node() == "node017"
+
+
+def test_has_environment(monkeypatch):
+    assert not runtime.has_environment() or "JAX_COORDINATOR_ADDRESS" in os.environ
+    monkeypatch.setenv("DMLCLOUD_TPU_COORDINATOR", "localhost:1234")
+    assert runtime.has_environment()
+
+
+def test_print_helpers(single_runtime, capsys):
+    runtime.print_root("hello")
+    runtime.print_worker("there")
+    out = capsys.readouterr().out
+    assert "hello" in out
+    assert "Worker 0 (0.0): there" in out
