@@ -40,10 +40,23 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     attn_impl: str = "dot"  # 'dot' | 'flash' | 'ring'
+    # MoE: replace the dense MLP with an expert-parallel MoEMLP (models/moe.py)
+    # in every ``moe_every``-th block (0 = dense everywhere). Experts shard
+    # over the ``expert`` mesh axis via moe_partition_rules().
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     seq_axis: str = "seq"  # mesh axis used when attn_impl == 'ring'
     # Mesh for attn_impl='ring' under plain jit (ring_attention_sharded wraps
     # itself in shard_map); leave None when the step is already shard_mapped.
     mesh: Any = None
+    # Residual-stream sharding constraint ([B, T, D] activations), applied
+    # after the embedding and every block. Pin this (e.g. a NamedSharding of
+    # P(('data','fsdp'))) on multi-axis meshes so XLA's sharding propagation
+    # keeps one layout instead of involuntarily rematerialising between
+    # conflicting choices. None = let XLA decide (fine on 1-axis meshes).
+    act_sharding: Any = None
 
     @property
     def kv_heads(self) -> int:
@@ -54,9 +67,14 @@ def llama_partition_rules() -> list[tuple[str, P]]:
     """T5X-style sharding rules for this model family: embeddings and heads
     over ``model`` (tensor parallel), with ``fsdp`` sharding the other large
     axis. Axes missing from the active mesh are dropped automatically
-    (parallel/mesh.py make_param_policy)."""
-    return [
-        ("embed/embedding", P("model", "fsdp")),
+    (parallel/mesh.py make_param_policy). Includes the MoE rules so
+    expert-parallel configs shard out of the box."""
+    from .moe import moe_partition_rules
+
+    return list(moe_partition_rules()) + [
+        # vocab over fsdp, features over model: the token gather then never
+        # crosses the model axis (each TP shard gathers its feature slice)
+        ("embed/embedding", P("fsdp", "model")),
         ("attn/(q|k|v)_proj/kernel", P("fsdp", "model")),
         ("attn/o_proj/kernel", P("model", "fsdp")),
         ("mlp/(gate|up)_proj/kernel", P("fsdp", "model")),
@@ -165,11 +183,26 @@ class MLP(nn.Module):
 
 class DecoderBlock(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin):
-        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
+        if self.use_moe:
+            from .moe import MoEConfig, MoEMLP
+
+            moe_cfg = MoEConfig(
+                num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                hidden_dim=cfg.hidden_dim,
+                mlp_dim=cfg.mlp_dim,
+                dtype=cfg.dtype,
+            )
+            x = x + MoEMLP(moe_cfg, name="moe")(RMSNorm(name="mlp_norm")(x))
+        else:
+            x = x + MLP(cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
         return x
 
 
@@ -186,8 +219,20 @@ class DecoderLM(nn.Module):
         )(tokens)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
+        def constrain(x):
+            if cfg.act_sharding is None:
+                return x
+            if hasattr(cfg.act_sharding, "shard_shape"):
+                try:  # skip when the (static) shape isn't divisible, e.g. module.init on a size-1 batch
+                    cfg.act_sharding.shard_shape(x.shape)
+                except (ValueError, ZeroDivisionError):
+                    return x
+            return jax.lax.with_sharding_constraint(x, cfg.act_sharding)
+
+        x = constrain(x)
         for i in range(cfg.num_layers):
-            x = DecoderBlock(cfg, name=f"layer_{i}")(x, cos, sin)
+            use_moe = cfg.num_experts > 0 and cfg.moe_every > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
+            x = constrain(DecoderBlock(cfg, use_moe=use_moe, name=f"layer_{i}")(x, cos, sin))
 
         x = RMSNorm(name="final_norm")(x)
         if cfg.tie_embeddings:
