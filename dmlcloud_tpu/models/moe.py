@@ -71,6 +71,8 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         b, t, d = x.shape
+        if d != cfg.hidden_dim:
+            raise ValueError(f"MoEMLP input dim {d} != cfg.hidden_dim {cfg.hidden_dim}")
         n_tok = b * t
         e = cfg.num_experts
         capacity = _round_up(max(int(n_tok / e * cfg.capacity_factor), 1), 8)

@@ -1,10 +1,11 @@
-from . import argparse_ext, config, git, logging, project, seed, slurm, table, tcp, thirdparty, wandb
+from . import argparse_ext, config, git, logging, profiling, project, seed, slurm, table, tcp, thirdparty, wandb
 
 __all__ = [
     "argparse_ext",
     "config",
     "git",
     "logging",
+    "profiling",
     "project",
     "seed",
     "slurm",

@@ -1,0 +1,77 @@
+"""Profiling helpers — the idiomatic upgrade over the reference's wall-clock
+timers (reference stage.py:299,303,314 tracks only ``misc/step_time_ms``;
+SURVEY.md §5.1): capture real XLA traces viewable in TensorBoard/Perfetto.
+
+- ``trace(logdir)``: context manager around ``jax.profiler`` — wrap any block
+  (a few train steps) to record device timelines, HLO op breakdown, and memory.
+- ``profile_steps(fn, n, logdir)``: run a callable ``n`` times under a trace.
+- ``StepTimer``: dispatch-to-dispatch wall timer with p50/p95 summaries, the
+  host-side complement used by bench.py.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import numpy as np
+
+__all__ = ["trace", "profile_steps", "StepTimer"]
+
+
+@contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    """Record a JAX profiler trace into ``logdir`` (TensorBoard-compatible).
+
+    Traces include the TPU device timeline, HLO-level op costs, and host
+    activity — strictly more than the reference's per-step wall timers.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_steps(fn, n: int, logdir: str, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` ``n`` times under a trace; returns the last
+    result (blocked until ready so the trace covers real device work)."""
+    import jax
+
+    result = None
+    with trace(logdir):
+        for _ in range(n):
+            result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+    return result
+
+
+class StepTimer:
+    """Dispatch-to-dispatch step timer with percentile summaries."""
+
+    def __init__(self):
+        self._t: list[float] = []
+        self._last: float | None = None
+
+    def tick(self) -> None:
+        now = time.perf_counter_ns()
+        if self._last is not None:
+            self._t.append((now - self._last) / 1e6)
+        self._last = now
+
+    @property
+    def count(self) -> int:
+        return len(self._t)
+
+    def summary(self) -> dict[str, float]:
+        if not self._t:
+            return {}
+        arr = np.asarray(self._t)
+        return {
+            "mean_ms": float(arr.mean()),
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p95_ms": float(np.percentile(arr, 95)),
+            "max_ms": float(arr.max()),
+        }

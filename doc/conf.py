@@ -1,0 +1,22 @@
+# Sphinx configuration (parity with reference doc/conf.py).
+import dmlcloud_tpu
+
+project = "dmlcloud-tpu"
+copyright = "2026"
+author = "dmlcloud-tpu contributors"
+version = dmlcloud_tpu.__version__
+release = version
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "myst_parser",
+]
+autosummary_generate = True
+napoleon_google_docstring = True
+
+source_suffix = {".rst": "restructuredtext", ".md": "markdown"}
+exclude_patterns = ["_build"]
+html_theme = "sphinx_rtd_theme"
