@@ -1,2 +1,3 @@
 """Model zoo: TPU-friendly flax implementations for the BASELINE.json ladder
-(MNIST CNN, ResNet-50, BERT-style encoder, ViT, Llama-style decoder LM)."""
+(MNIST CNN, ResNet-50, BERT-style encoder, ViT, CLIP dual encoder,
+Llama-style decoder LM with optional MoE)."""

@@ -1,0 +1,140 @@
+"""BERT-style bidirectional encoder — the BASELINE.json "BERT fine-tune with
+sharded data" config family, TPU-first:
+
+- Word + learned-position + segment embeddings, pre-LN encoder core
+  (models/encoder.py), bf16 matmuls / fp32 norms.
+- Padding handled as an additive softmax bias (no dynamic shapes — XLA
+  compiles one program for all mask patterns).
+- MLM head tied to the word embedding (one [D, V] matmul on the MXU);
+  ``ignore_index=-100`` label convention in :func:`mlm_loss`.
+- Sequence classification via a tanh pooler over the [CLS] position.
+
+The reference has no model zoo (/root/reference/dmlcloud/pipeline.py:55-75);
+this covers the encoder configs its users bring, sharded by
+encoder_partition_rules().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from .encoder import AddLearnedPositions, EncoderConfig, TransformerEncoder, padding_mask_bias
+
+IGNORE_INDEX = -100
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def encoder(self) -> EncoderConfig:
+        return EncoderConfig(
+            hidden_dim=self.hidden_dim,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            causal=False,
+            dropout_rate=self.dropout_rate,
+        )
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_type_ids=None):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="word"
+        )
+        x = embed(tokens)
+        x = AddLearnedPositions(cfg.max_seq_len, name="pos_embed")(x)
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(tokens)
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="type"
+            )(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="norm")(x)
+        return x.astype(cfg.dtype)
+
+
+class BertEncoder(nn.Module):
+    """tokens [B, T] -> hidden states [B, T, D]."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None, train: bool = False):
+        x = BertEmbeddings(self.cfg, name="embeddings")(tokens, token_type_ids)
+        bias = padding_mask_bias(attention_mask) if attention_mask is not None else None
+        return TransformerEncoder(self.cfg.encoder, name="encoder")(x, bias, train=train)
+
+
+class BertForMaskedLM(nn.Module):
+    """tokens [B, T] -> MLM logits [B, T, V] fp32, decoder tied to word embed."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None, train: bool = False):
+        cfg = self.cfg
+        h = BertEncoder(cfg, name="bert")(tokens, attention_mask, token_type_ids, train=train)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlm_transform")(h)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="mlm_norm")(h)
+        embedding = self.variables["params"]["bert"]["embeddings"]["word"]["embedding"]
+        # bf16 operands on the MXU, fp32 accumulation — the [B*T,D]x[D,V]
+        # matmul is the model's largest and must not run in fp32
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            h.astype(cfg.dtype),
+            embedding.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        bias = self.param("mlm_bias", nn.initializers.zeros_init(), (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+class BertForSequenceClassification(nn.Module):
+    """tokens [B, T] -> class logits [B, num_classes] fp32 (tanh CLS pooler)."""
+
+    cfg: BertConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, token_type_ids=None, train: bool = False):
+        h = BertEncoder(self.cfg, name="bert")(tokens, attention_mask, token_type_ids, train=train)
+        pooled = nn.tanh(
+            nn.Dense(self.cfg.hidden_dim, dtype=jnp.float32, param_dtype=jnp.float32, name="pooler")(
+                h[:, 0].astype(jnp.float32)
+            )
+        )
+        return nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="classifier")(
+            pooled
+        )
+
+
+def mlm_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Masked cross entropy: positions with ``labels == IGNORE_INDEX`` are
+    skipped; the mean is over masked positions only (static shapes — the mask
+    is a weight, not a gather)."""
+    keep = (labels != IGNORE_INDEX).astype(jnp.float32)
+    safe_labels = jnp.where(labels == IGNORE_INDEX, 0, labels)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    return jnp.sum(per_tok * keep) / jnp.maximum(jnp.sum(keep), 1.0)

@@ -1,0 +1,140 @@
+"""CLIP-style contrastive dual encoder — the BASELINE.json "ViT-L/CLIP"
+rung, TPU-first:
+
+- Image tower: ViT with ``num_classes=0`` (pooled features); text tower: a
+  causal TransformerEncoder whose sequence feature is read at the EOT
+  position (highest token id, the CLIP convention).
+- Both towers project into a shared ``embed_dim`` and are L2-normalised in
+  fp32; a learnable ``logit_scale`` (stored as log, clamped at 100) scales
+  the similarity.
+- **Global-batch contrastive loss under data parallelism**:
+  :func:`clip_loss` takes an optional ``axis_name`` — inside a shard_mapped /
+  pmapped step it ``all_gather``s both embedding sets over the data axis so
+  every device contrasts its local examples against the GLOBAL batch, with
+  label offsets computed from ``axis_index``. The gather rides ICI; XLA
+  overlaps it with the tower matmuls.
+
+The reference has no model zoo (/root/reference/dmlcloud/pipeline.py:55-75).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from .encoder import AddLearnedPositions, EncoderConfig, TransformerEncoder
+from .vit import ViT, ViTConfig
+
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    max_seq_len: int = 77
+    hidden_dim: int = 512
+    num_layers: int = 12
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def encoder(self) -> EncoderConfig:
+        return EncoderConfig(
+            hidden_dim=self.hidden_dim,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            causal=True,
+        )
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    embed_dim: int = 512
+    vision: ViTConfig = field(default_factory=lambda: ViTConfig(num_classes=0))
+    text: CLIPTextConfig = field(default_factory=CLIPTextConfig)
+
+
+class CLIPTextTower(nn.Module):
+    cfg: CLIPTextConfig
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="token_embed"
+        )(tokens)
+        x = AddLearnedPositions(cfg.max_seq_len, stddev=0.01, name="pos_embed")(x)
+        x = TransformerEncoder(cfg.encoder, name="encoder")(x, train=train)
+        # EOT = highest token id in each sequence (CLIP tokenizer convention)
+        eot = jnp.argmax(tokens, axis=-1)
+        feats = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        return nn.Dense(
+            self.embed_dim, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="proj"
+        )(feats.astype(jnp.float32))
+
+
+class CLIP(nn.Module):
+    """(images, tokens) -> (image_emb, text_emb, logit_scale); embeddings are
+    L2-normalised fp32 [B, embed_dim]."""
+
+    cfg: CLIPConfig
+
+    def setup(self):
+        self.visual = ViT(self.cfg.vision)
+        self.vision_proj = nn.Dense(
+            self.cfg.embed_dim, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32
+        )
+        self.text = CLIPTextTower(self.cfg.text, self.cfg.embed_dim)
+        self.log_logit_scale = self.param(
+            "log_logit_scale", nn.initializers.constant(jnp.log(1 / 0.07)), (), jnp.float32
+        )
+
+    def encode_image(self, images, train: bool = False):
+        feats = self.visual(images, train=train)
+        return _l2_normalize(self.vision_proj(feats.astype(jnp.float32)))
+
+    def encode_text(self, tokens, train: bool = False):
+        return _l2_normalize(self.text(tokens, train=train))
+
+    def __call__(self, images, tokens, train: bool = False):
+        img = self.encode_image(images, train=train)
+        txt = self.encode_text(tokens, train=train)
+        scale = jnp.minimum(jnp.exp(self.log_logit_scale), 100.0)
+        return img, txt, scale
+
+
+def _l2_normalize(x: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)
+
+
+def clip_loss(
+    image_emb: jnp.ndarray,
+    text_emb: jnp.ndarray,
+    logit_scale: jnp.ndarray,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Symmetric InfoNCE. With ``axis_name`` (inside shard_map/pmap over the
+    data axis), both embedding sets are all-gathered so each local example
+    contrasts against the GLOBAL batch; labels are offset by
+    ``axis_index * local_batch``."""
+    local = image_emb.shape[0]
+    if axis_name is None:
+        all_img, all_txt, offset = image_emb, text_emb, 0
+    else:
+        all_img = jax.lax.all_gather(image_emb, axis_name, tiled=True)
+        all_txt = jax.lax.all_gather(text_emb, axis_name, tiled=True)
+        offset = jax.lax.axis_index(axis_name) * local
+
+    labels = jnp.arange(local) + offset
+    logits_i = logit_scale * image_emb @ all_txt.T  # [local, global]
+    logits_t = logit_scale * text_emb @ all_img.T
+    loss_i = optax.softmax_cross_entropy_with_integer_labels(logits_i, labels).mean()
+    loss_t = optax.softmax_cross_entropy_with_integer_labels(logits_t, labels).mean()
+    return 0.5 * (loss_i + loss_t)

@@ -1,0 +1,169 @@
+"""Bidirectional transformer encoder core shared by the ViT / BERT / CLIP
+families.
+
+The reference ships no model code at all — models are user-supplied
+``nn.Module``s (/root/reference/dmlcloud/pipeline.py:55-75). This zoo exists
+to cover the BASELINE.json config ladder (ResNet-50 → BERT fine-tune →
+ViT-L/CLIP → Llama) with TPU-first implementations:
+
+- Pre-LN blocks, GELU MLP; LayerNorm accumulates in fp32, matmuls run bf16
+  on the MXU.
+- Attention masks are additive fp32 biases ``[B, 1, T, S]`` (already in
+  log-space), so padding masks fuse into the softmax instead of branching.
+- ``causal=True`` adds a triangular bias — used by the CLIP text tower.
+- Sharding is data, not code: :func:`encoder_partition_rules` shards heads
+  and the MLP hidden over the ``model`` mesh axis and the other large axis
+  over ``fsdp``, mirroring the decoder family (transformer.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    causal: bool = False
+    dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_dim % self.num_heads == 0
+        return self.hidden_dim // self.num_heads
+
+
+def encoder_partition_rules() -> list[tuple[str, P]]:
+    """T5X-style rules for the encoder family (ViT / BERT / CLIP towers)."""
+    return [
+        ("attn/(q|k|v)_proj/kernel", P("fsdp", "model")),
+        ("attn/o_proj/kernel", P("model", None, "fsdp")),
+        ("mlp/fc_in/kernel", P("fsdp", "model")),
+        ("mlp/fc_out/kernel", P("model", "fsdp")),
+        ("(^|/)embedding$", P("fsdp", "model")),  # nn.Embed tables only, not pos_embedding
+        (".*", P()),
+    ]
+
+
+class AddLearnedPositions(nn.Module):
+    """``x + pos[:, :T]`` with a learned fp32 table ``[1, max_len, D]``.
+
+    The one copy of the positional-embedding pattern shared by the ViT, BERT
+    and CLIP towers; rejects sequences longer than ``max_len`` at trace time
+    instead of failing deep inside a broadcast.
+    """
+
+    max_len: int
+    stddev: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        t = x.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} exceeds max_len {self.max_len}")
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=self.stddev),
+            (1, self.max_len, x.shape[-1]),
+            jnp.float32,
+        )
+        return x + pos[:, :t].astype(x.dtype)
+
+
+def padding_mask_bias(attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """[B, S] {0,1} keep-mask -> additive fp32 bias [B, 1, 1, S]."""
+    return jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, NEG_INF).astype(jnp.float32)
+
+
+class EncoderAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias=None):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        dense = lambda name: nn.DenseGeneral(
+            (cfg.num_heads, cfg.head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        q = dense("q_proj")(x)
+        k = dense("k_proj")(x)
+        v = dense("v_proj")(x)
+
+        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        scores = scores / jnp.sqrt(cfg.head_dim)
+        if cfg.causal:
+            causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+            scores = jnp.where(causal[None, None], scores, NEG_INF)
+        if mask_bias is not None:
+            scores = scores + mask_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        return nn.DenseGeneral(
+            cfg.hidden_dim,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="o_proj",
+        )(out)
+
+
+class EncoderMLP(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="fc_in")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="fc_out")(h)
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias=None, train: bool = False):
+        cfg = self.cfg
+        norm = lambda name: nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name=name
+        )
+        drop = lambda y: nn.Dropout(cfg.dropout_rate)(y, deterministic=not train)
+        x = x + drop(EncoderAttention(cfg, name="attn")(norm("attn_norm")(x).astype(cfg.dtype), mask_bias))
+        x = x + drop(EncoderMLP(cfg, name="mlp")(norm("mlp_norm")(x).astype(cfg.dtype)))
+        return x
+
+
+class TransformerEncoder(nn.Module):
+    """Stack of pre-LN encoder blocks + final LayerNorm.
+
+    ``x``: [B, T, D] embeddings; returns [B, T, D] in ``cfg.dtype``.
+    """
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias=None, train: bool = False):
+        cfg = self.cfg
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, mask_bias, train=train)
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name="final_norm"
+        )(x)
+        return x.astype(cfg.dtype)

@@ -1,0 +1,104 @@
+"""Vision Transformer (ViT) — the BASELINE.json "ViT-L multi-epoch vision
+run" config family, TPU-first:
+
+- Patch embedding is one strided Conv (patch×patch, stride patch) — a single
+  big MXU matmul per image, NHWC, no im2col.
+- bf16 activations / fp32 params and LayerNorms (models/encoder.py).
+- 'cls' (prepended class token) or 'gap' (global average pool) pooling;
+  ``num_classes=0`` returns pooled features (the CLIP image tower).
+- Sharding via encoder_partition_rules(): heads/MLP over ``model``, large
+  sibling axes over ``fsdp`` — same mesh machinery as the decoder LM.
+
+The reference has no model zoo (models are user nn.Modules,
+/root/reference/dmlcloud/pipeline.py:55-75); this covers its users' vision
+configs natively.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .encoder import AddLearnedPositions, EncoderConfig, TransformerEncoder
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000  # 0 => return pooled features (no head)
+    pooling: str = "cls"  # 'cls' | 'gap'
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def encoder(self) -> EncoderConfig:
+        return EncoderConfig(
+            hidden_dim=self.hidden_dim,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            causal=False,
+            dropout_rate=self.dropout_rate,
+        )
+
+
+class ViT(nn.Module):
+    """images [B, H, W, C] -> logits [B, num_classes] fp32 (or features)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        b = images.shape[0]
+        x = nn.Conv(
+            cfg.hidden_dim,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.hidden_dim)  # [B, P, D]
+
+        if cfg.pooling == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros_init(), (1, 1, cfg.hidden_dim), jnp.float32)
+            x = jnp.concatenate([jnp.tile(cls.astype(cfg.dtype), (b, 1, 1)), x], axis=1)
+        x = AddLearnedPositions(x.shape[1], name="pos_embed")(x)
+
+        x = TransformerEncoder(cfg.encoder, name="encoder")(x, train=train)
+
+        if cfg.pooling == "cls":
+            pooled = x[:, 0]
+        elif cfg.pooling == "gap":
+            pooled = jnp.mean(x, axis=1)
+        else:
+            raise ValueError(f"unknown pooling {cfg.pooling!r}")
+
+        if cfg.num_classes == 0:
+            return pooled
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="head"
+        )(pooled.astype(jnp.float32))
+
+
+def ViT_S16(**kw) -> ViT:
+    return ViT(ViTConfig(patch_size=16, hidden_dim=384, num_layers=12, num_heads=6, mlp_dim=1536, **kw))
+
+
+def ViT_B16(**kw) -> ViT:
+    return ViT(ViTConfig(patch_size=16, hidden_dim=768, num_layers=12, num_heads=12, mlp_dim=3072, **kw))
+
+
+def ViT_L16(**kw) -> ViT:
+    return ViT(ViTConfig(patch_size=16, hidden_dim=1024, num_layers=24, num_heads=16, mlp_dim=4096, **kw))

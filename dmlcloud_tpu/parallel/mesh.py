@@ -183,11 +183,41 @@ def make_param_policy(policy: str | PartitionRules | Callable[[str, Any], P]) ->
         def apply_rules(path: str, leaf: Any, mesh: Mesh) -> P:
             for pat, spec in rules:
                 if pat.search(path):
-                    # drop axes the mesh doesn't have (lets one rule set serve many meshes)
-                    cleaned = tuple(
-                        a if (a is None or all(x in mesh.axis_names for x in ((a,) if isinstance(a, str) else a))) else None
-                        for a in spec
-                    )
+                    # Drop axes the mesh doesn't have (lets one rule set serve
+                    # many meshes). Axes that don't divide their param dim get
+                    # relocated to another divisible dim if one exists (e.g. a
+                    # 30522-row word table on fsdp=4 moves the fsdp shards to
+                    # the hidden dim), else dropped with a warning — the rule
+                    # must also cover e.g. a 2-row type table without crashing.
+                    shape = getattr(leaf, "shape", ())
+                    cleaned: list = []
+                    displaced: list = []
+                    for i, a in enumerate(spec):
+                        axes = (a,) if isinstance(a, str) else a
+                        if a is None or not all(x in mesh.axis_names for x in axes):
+                            cleaned.append(None)
+                            continue
+                        n = math.prod(mesh.shape[x] for x in axes)
+                        if i < len(shape) and shape[i] % n == 0:
+                            cleaned.append(a)
+                        else:
+                            cleaned.append(None)
+                            displaced.append((a, n))
+                    if displaced:
+                        cleaned += [None] * (len(shape) - len(cleaned))
+                    for a, n in displaced:
+                        for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                            if cleaned[i] is None and shape[i] % n == 0 and shape[i] >= 2 * n:
+                                cleaned[i] = a
+                                break
+                        else:
+                            import logging
+
+                            logging.getLogger("dmlcloud_tpu").warning(
+                                "param %s: no dim of shape %s divisible by axis %r "
+                                "(size %d); leaving that axis unsharded (replicated)",
+                                path, tuple(shape), a, n,
+                            )
                     return P(*cleaned)
             return _fsdp_spec(leaf, mesh) if FSDP in mesh.axis_names else P()
 
