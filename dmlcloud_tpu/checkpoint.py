@@ -87,7 +87,8 @@ class CheckpointDir:
 
     def __init__(self, path: str | Path):
         self.path = Path(path).resolve()
-        self._state_manager = None
+        self._state_managers: dict[str | None, Any] = {}
+        self._manager_opts: dict[str | None, tuple] = {}
 
     # -- contract files -----------------------------------------------------
     @property
@@ -143,32 +144,57 @@ class CheckpointDir:
         return Config.load(self.config_file)
 
     # -- tensor state via Orbax (new capability vs reference) ---------------
-    def state_manager(self, max_to_keep: int = 3, async_save: bool = True, **options):
-        """An Orbax CheckpointManager rooted at ``state/``. Collective: every
-        process must participate in save/restore calls."""
-        if self._state_manager is None:
-            import orbax.checkpoint as ocp
+    def state_manager(
+        self, scope: str | None = None, max_to_keep: int | None = None, async_save: bool | None = None, **options
+    ):
+        """An Orbax CheckpointManager rooted at ``state/`` (or
+        ``state/<scope>`` — stages checkpoint under their own scope so step
+        ids never collide across stages). Collective: every process must
+        participate in save/restore calls. Async saves copy device→host
+        synchronously, so donated step buffers are safe.
 
-            opts = ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                enable_async_checkpointing=async_save,
-                **options,
-            )
-            self._state_manager = ocp.CheckpointManager(self.state_dir, options=opts)
-        return self._state_manager
+        Defaults: ``max_to_keep=3``, ``async_save=True``. Options bind at
+        FIRST creation per scope (e.g. in ``pre_stage``); explicitly passing
+        different options for an existing scope raises."""
+        explicit = max_to_keep is not None or async_save is not None or bool(options)
+        requested = (
+            3 if max_to_keep is None else max_to_keep,
+            True if async_save is None else async_save,
+            tuple(sorted(options.items())),
+        )
+        if scope in self._state_managers:
+            cached = self._manager_opts[scope]
+            if explicit and requested != cached:
+                raise RuntimeError(
+                    f"Orbax manager for scope {scope!r} already exists with options "
+                    f"{cached}; configure it via state_manager(...) BEFORE the first "
+                    "save/restore for that scope (e.g. in pre_stage)"
+                )
+            return self._state_managers[scope]
+        import orbax.checkpoint as ocp
 
-    def save_state(self, step: int, state: Any, **kwargs) -> None:
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=requested[0],
+            enable_async_checkpointing=requested[1],
+            **options,
+        )
+        root = self.state_dir / scope if scope else self.state_dir
+        self._state_managers[scope] = ocp.CheckpointManager(root, options=opts)
+        self._manager_opts[scope] = requested
+        return self._state_managers[scope]
+
+    def save_state(self, step: int, state: Any, scope: str | None = None, **kwargs) -> None:
         """Save a pytree of (possibly sharded) arrays under ``state/<step>``."""
         import orbax.checkpoint as ocp
 
-        self.state_manager().save(step, args=ocp.args.StandardSave(state), **kwargs)
+        self.state_manager(scope).save(step, args=ocp.args.StandardSave(state), **kwargs)
 
-    def restore_state(self, step: int | None = None, template: Any = None) -> Any:
+    def restore_state(self, step: int | None = None, template: Any = None, scope: str | None = None) -> Any:
         """Restore the latest (or a given) step; with ``template``, arrays are
         restored with the template's shardings/dtypes."""
         import orbax.checkpoint as ocp
 
-        mgr = self.state_manager()
+        mgr = self.state_manager(scope)
         if step is None:
             step = mgr.latest_step()
         if step is None:
@@ -177,18 +203,19 @@ class CheckpointDir:
             return mgr.restore(step, args=ocp.args.StandardRestore(template))
         return mgr.restore(step)
 
-    def latest_step(self) -> int | None:
-        return self.state_manager().latest_step()
+    def latest_step(self, scope: str | None = None) -> int | None:
+        return self.state_manager(scope).latest_step()
 
     def wait_until_finished(self) -> None:
         """Block until pending async saves commit."""
-        if self._state_manager is not None:
-            self._state_manager.wait_until_finished()
+        for mgr in self._state_managers.values():
+            mgr.wait_until_finished()
 
     def close(self) -> None:
-        if self._state_manager is not None:
-            self._state_manager.close()
-            self._state_manager = None
+        for mgr in self._state_managers.values():
+            mgr.close()
+        self._state_managers = {}
+        self._manager_opts = {}
 
     def __str__(self) -> str:
         return str(self.path)

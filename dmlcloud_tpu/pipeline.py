@@ -176,7 +176,20 @@ class TrainingPipeline:
             raise ValueError("stage must be a Stage object")
         stage.pipeline = self
         stage.max_epochs = max_epochs
-        stage.name = name or type(stage).__name__
+        # unique name: it keys the stage's checkpoint scope (state/<name>).
+        # Explicit duplicates are an error (like register_model); anonymous
+        # same-class stages get a numeric suffix.
+        existing = {s.name for s in self.stages}
+        if name is not None:
+            if name in existing:
+                raise ValueError(f"Stage with name {name!r} already exists")
+            stage.name = name
+        else:
+            base = type(stage).__name__
+            unique, i = base, 2
+            while unique in existing:
+                unique, i = f"{base}_{i}", i + 1
+            stage.name = unique
         self.stages.append(stage)
 
     # -- registry lookups used by TrainValStage -----------------------------

@@ -138,14 +138,14 @@ class Stage:
 
     # -- lifecycle (reference stage.py:132-205) -----------------------------
     def run(self):
-        """Run until ``max_epochs`` or ``stop_stage()``."""
+        """Run until ``max_epochs`` or ``stop_stage()``. A restored
+        ``_stop_requested`` (stage already stopped before the interruption)
+        skips the loop entirely."""
         self._pre_stage()
-        while self.max_epochs is None or self.current_epoch <= self.max_epochs:
+        while not self._stop_requested and (self.max_epochs is None or self.current_epoch <= self.max_epochs):
             self._pre_epoch()
             self.run_epoch()
             self._post_epoch()
-            if self._stop_requested:
-                break
         self._post_stage()
 
     def _pre_stage(self):
@@ -284,18 +284,39 @@ class TrainValStage(Stage):
         """Which registered model this stage trains (None = the only one)."""
         return None
 
+    def checkpoint_every(self) -> int:
+        """Epochs between automatic TrainState saves (0 disables). Active
+        only when ``pipeline.enable_checkpointing()`` was called. The
+        reference leaves tensor state to user hooks (SURVEY.md §3.5); here a
+        resumed pipeline continues bit-for-bit: params, optimizer state, rng,
+        extras, metric histories, and the epoch counter are all restored."""
+        return 1
+
     # -- state construction -------------------------------------------------
     def make_state(self) -> TrainState:
         """Build the TrainState from the pipeline registries. Override for
-        multi-model setups."""
+        multi-model setups.
+
+        Registry arrays are COPIED into the state: the compiled step donates
+        its input state, and on the first call those buffers would otherwise
+        be the registry's own arrays — a later stage (or user code reading
+        ``pipeline.models`` after the run) would see deleted buffers. The rng
+        is folded per stage so stages draw independent streams."""
         entry = self.pipeline._model_entry(self.model_name())
         tx = self.pipeline._optimizer_for(entry.name)
+
+        def fresh(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree
+            )
+
+        stage_index = self.pipeline.stages.index(self) if self in self.pipeline.stages else 0
         return TrainState.create(
             apply_fn=entry.apply_fn,
-            params=entry.params,
+            params=fresh(entry.params),
             tx=tx,
-            rng=self.pipeline.root_key,
-            extras=entry.extras,
+            rng=jax.random.fold_in(self.pipeline.root_key, stage_index),
+            extras=fresh(entry.extras) if entry.extras is not None else None,
             mesh=self.mesh,
             policy=entry.policy,
         )
@@ -373,8 +394,87 @@ class TrainValStage(Stage):
             entry = self.pipeline._model_entry(self.model_name())
             self._policy = entry.policy
             self.state = self.make_state()
+        if self.pipeline.resumed and int(self.checkpoint_every()) > 0:
+            # manual mode (checkpoint_every()==0) owns its restore layout too
+            self._restore_state()
         self._train_step_fn = self._build_train_step()
         self._val_step_fn = self._build_val_step()
+
+    def _post_epoch(self):
+        super()._post_epoch()
+        self._maybe_save_state()
+
+    def _post_stage(self):
+        # publish trained params back to the registry so a following stage
+        # continues from them (the reference's in-place nn.Module semantics)
+        if self.state is not None:
+            entry = self.pipeline._model_entry(self.model_name())
+            entry.params = self.state.params
+            entry.extras = self.state.extras
+        super()._post_stage()
+
+    # -- automatic state checkpointing (closes reference gap, SURVEY.md §3.5) --
+    def _state_pytree(self) -> dict:
+        tree = {
+            "step": self.state.step,
+            "params": self.state.params,
+            "opt_state": self.state.opt_state,
+            "rng": self.state.rng,
+        }
+        if self.state.extras is not None:
+            tree["extras"] = self.state.extras
+        return tree
+
+    def _maybe_save_state(self):
+        ckpt = self.pipeline.checkpoint_dir
+        every = int(self.checkpoint_every())
+        if ckpt is None or every <= 0 or self.state is None:
+            return
+        completed = self.current_epoch - 1  # super()._post_epoch incremented
+        final = completed == self.max_epochs or self._stop_requested
+        if completed % every != 0 and not final:
+            return
+        ckpt.save_state(completed, self._state_pytree(), scope=self.name)
+        if is_root():
+            import pickle
+
+            meta_dir = ckpt.path / "meta" / self.name
+            meta_dir.mkdir(parents=True, exist_ok=True)
+            meta = {
+                "epoch": completed,
+                "stopped": self._stop_requested,
+                "tracker": self.tracker.state_dict(),
+            }
+            (meta_dir / f"{completed}.pkl").write_bytes(pickle.dumps(meta))
+            # keep sidecars in lockstep with Orbax retention (max_to_keep)
+            kept = set(ckpt.state_manager(self.name).all_steps()) | {completed}
+            for f in meta_dir.glob("*.pkl"):
+                if f.stem.isdigit() and int(f.stem) not in kept:
+                    f.unlink(missing_ok=True)
+
+    def _restore_state(self):
+        ckpt = self.pipeline.checkpoint_dir
+        if ckpt is None or self.state is None:
+            return
+        latest = ckpt.latest_step(scope=self.name)
+        if latest is None:
+            return  # e.g. crash before this stage's first save
+        restored = ckpt.restore_state(latest, template=self._state_pytree(), scope=self.name)
+        self.state = self.state.replace(**restored)
+        meta_file = ckpt.path / "meta" / self.name / f"{latest}.pkl"
+        if meta_file.exists():
+            import pickle
+
+            meta = pickle.loads(meta_file.read_bytes())
+            self.tracker.load_state_dict(meta["tracker"])
+            self.current_epoch = int(meta["epoch"]) + 1
+            # a stage that had already stopped early must not re-train
+            self._stop_requested = bool(meta.get("stopped", False))
+        else:
+            self.current_epoch = latest + 1
+        self.logger.info(
+            f"Restored stage '{self.name}' state from epoch {latest}; continuing at epoch {self.current_epoch}"
+        )
 
     def run_epoch(self):
         self.train_epoch()

@@ -106,6 +106,11 @@ def main():
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from --checkpoint-dir (a run dir, or a root scanned by Slurm job id)",
+    )
     args = parser.parse_args()
 
     init_auto(verbose=True)
@@ -113,7 +118,7 @@ def main():
     config = {"batch_size": args.batch_size, "lr": args.lr, "seed": 42}
     pipeline = dml.TrainingPipeline(config, name="mnist")
     if args.checkpoint_dir:
-        pipeline.enable_checkpointing(args.checkpoint_dir)
+        pipeline.enable_checkpointing(args.checkpoint_dir, resume=args.resume)
     pipeline.append_stage(MnistStage(), max_epochs=args.epochs)
     pipeline.run()
 

@@ -1,0 +1,157 @@
+"""Checkpoint/resume integration: a pipeline interrupted after N epochs and
+resumed must reproduce the uninterrupted run — params, optimizer state, metric
+histories, and epoch accounting (the reference can only re-find its directory
+and call a user hook, SURVEY.md §3.5; here resume is bit-for-bit)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import dmlcloud_tpu as dml
+
+
+class _ToyStage(dml.TrainValStage):
+    """Deterministic linear-regression stage on a fixed synthetic dataset."""
+
+    def __init__(self, stop_after: int | None = None):
+        super().__init__()
+        self._stop_after = stop_after
+
+    def pre_stage(self):
+        if "linear" in self.pipeline.models:
+            return  # second stage in a multi-stage pipeline reuses the registry
+        rng = np.random.RandomState(42)
+        w_true = rng.randn(4, 1).astype(np.float32)
+        xs = rng.randn(8, 16, 4).astype(np.float32)
+        batches = [{"x": jnp.asarray(x), "y": jnp.asarray(x @ w_true)} for x in xs]
+        self.pipeline.register_model(
+            "linear",
+            apply_fn=lambda p, x: x @ p["w"],
+            params={"w": jnp.zeros((4, 1))},
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.05, momentum=0.9))
+        self.pipeline.register_dataset("train", batches, verbose=False)
+
+    def step(self, state, batch):
+        pred = state.apply_fn(state.params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+    def post_epoch(self):
+        if self._stop_after is not None and self.current_epoch >= self._stop_after:
+            self.stop_stage()
+
+
+def _run(tmp_path, resume_from=None, max_epochs=5, stop_after=None, name="toy"):
+    pipeline = dml.TrainingPipeline(name=name)
+    stage = _ToyStage(stop_after=stop_after)
+    pipeline.append_stage(stage, max_epochs=max_epochs, name="TrainValStage")
+    if resume_from is not None:
+        pipeline.enable_checkpointing(resume_from, resume=True)
+    else:
+        pipeline.enable_checkpointing(str(tmp_path))
+    pipeline.run()
+    return pipeline, stage
+
+
+def test_resume_matches_uninterrupted(tmp_path, single_runtime):
+    # 1) interrupted run: completes only 2 of the eventual 5 epochs
+    p1, s1 = _run(tmp_path / "a", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    assert p1.resumed is False
+    assert s1.current_epoch == 3  # two epochs completed
+    p1.checkpoint_dir.close()
+
+    # 2) resume: picks up at epoch 3, finishes 5
+    p2, s2 = _run(tmp_path / "a", resume_from=run_dir, max_epochs=5)
+    assert p2.resumed is True
+    assert str(p2.checkpoint_dir) == run_dir
+    assert s2.current_epoch == 6
+    # tracker has the full 5-epoch history, not just the resumed tail
+    assert len(p2.tracker["train/loss"]) == 5
+    p2.checkpoint_dir.close()
+
+    # 3) control: the same 5 epochs uninterrupted
+    p3, s3 = _run(tmp_path / "b", max_epochs=5)
+
+    w_resumed = np.asarray(s2.state.params["w"])
+    w_control = np.asarray(s3.state.params["w"])
+    np.testing.assert_allclose(w_resumed, w_control, rtol=1e-6, atol=1e-7)
+
+    # optimizer momentum buffers match too
+    mom_resumed = jax.tree_util.tree_leaves(s2.state.opt_state)
+    mom_control = jax.tree_util.tree_leaves(s3.state.opt_state)
+    for a, b in zip(mom_resumed, mom_control):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+    # loss history of the resumed tail equals the control's tail
+    tail_resumed = [float(v) for v in p2.tracker["train/loss"][2:]]
+    tail_control = [float(v) for v in p3.tracker["train/loss"][2:]]
+    np.testing.assert_allclose(tail_resumed, tail_control, rtol=1e-6)
+    p3.checkpoint_dir.close()
+
+
+def test_fresh_dir_when_not_resuming(tmp_path, single_runtime):
+    p1, _ = _run(tmp_path / "x", max_epochs=1)
+    p2, _ = _run(tmp_path / "x", max_epochs=1)
+    assert str(p1.checkpoint_dir) != str(p2.checkpoint_dir)
+    assert p1.resumed is False and p2.resumed is False
+    p1.checkpoint_dir.close()
+    p2.checkpoint_dir.close()
+
+
+def test_stopped_stage_not_retrained_on_resume(tmp_path, single_runtime):
+    """A stage that ended early via stop_stage() must stay stopped on resume —
+    not silently re-train its remaining epochs with a stale stop condition."""
+    p1, s1 = _run(tmp_path / "s", max_epochs=10, stop_after=2)
+    run_dir = str(p1.checkpoint_dir)
+    assert s1.current_epoch == 3  # stopped after epoch 2
+    n_epochs_before = len(p1.tracker["train/loss"])
+    p1.checkpoint_dir.close()
+
+    p2, s2 = _run(tmp_path / "s", resume_from=run_dir, max_epochs=10)
+    assert s2._stop_requested is True
+    assert s2.current_epoch == 3  # no additional epochs ran
+    assert len(p2.tracker["train/loss"]) == n_epochs_before
+    p2.checkpoint_dir.close()
+
+
+def test_duplicate_explicit_stage_name_raises(single_runtime):
+    pipeline = dml.TrainingPipeline(name="dup")
+    pipeline.append_stage(_ToyStage(), max_epochs=1, name="pretrain")
+    with pytest.raises(ValueError, match="already exists"):
+        pipeline.append_stage(_ToyStage(), max_epochs=1, name="pretrain")
+
+
+def test_two_unnamed_stages_get_distinct_scopes(tmp_path, single_runtime):
+    """Two unnamed stages of the same class must not share a checkpoint scope
+    (Orbax step ids would collide and resume would restore the wrong stage)."""
+    pipeline = dml.TrainingPipeline(name="two")
+    pipeline.append_stage(_ToyStage(), max_epochs=1)
+    pipeline.append_stage(_ToyStage(), max_epochs=1)
+    assert pipeline.stages[0].name != pipeline.stages[1].name
+    pipeline.enable_checkpointing(str(tmp_path))
+    pipeline.run()
+    state_root = pipeline.checkpoint_dir.state_dir
+    assert (state_root / pipeline.stages[0].name).exists()
+    assert (state_root / pipeline.stages[1].name).exists()
+    pipeline.checkpoint_dir.close()
+
+
+def test_checkpoint_every_zero_disables_state_saves(tmp_path, single_runtime):
+    class NoCkptStage(_ToyStage):
+        def checkpoint_every(self):
+            return 0
+
+    pipeline = dml.TrainingPipeline(name="nockpt")
+    pipeline.append_stage(NoCkptStage(), max_epochs=1, name="TrainValStage")
+    pipeline.enable_checkpointing(str(tmp_path))
+    pipeline.run()
+    state_dir = pipeline.checkpoint_dir.state_dir / "TrainValStage"
+    assert not state_dir.exists() or not any(state_dir.iterdir())
+    pipeline.checkpoint_dir.close()
