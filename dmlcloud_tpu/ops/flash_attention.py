@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels (forward AND backward).
 
 The reference framework has no attention code at all (SURVEY.md §5.7); models
 were user-space. The TPU build ships attention as a first-class fused op
@@ -6,17 +6,25 @@ because it is *the* hot op of the transformer configs in BASELINE.json.
 
 Kernel design (online-softmax, Dao-style but TPU-shaped):
 
-- Grid: ``(batch*heads, T/block_q)`` — each program owns one query block and
-  streams the K/V sequence through VMEM with ``pl.ds`` slices, keeping the
-  running max/denominator in fp32 registers (carried through a
+- Forward grid: ``(batch*heads, T/block_q)`` — each program owns one query
+  block and streams the K/V sequence through VMEM with ``pl.ds`` slices,
+  keeping the running max/denominator in fp32 registers (carried through a
   ``lax.fori_loop``). O(T) HBM traffic for K/V, no [T, S] score matrix ever
-  materialises.
-- MXU does q@k^T and p@v in bf16 with fp32 accumulation
-  (``preferred_element_type``); VPU does the exp/renormalisation.
-- Causal masking skips *entire* K blocks past the diagonal (loop bound
-  depends on ``program_id``), and masks only inside the diagonal block.
+  materialises. The differentiable path also writes the per-row logsumexp
+  (the FlashAttention-2 residual: O and LSE, nothing else).
+- Backward: two kernels sharing the saved LSE and the precomputed
+  ``delta = rowsum(dO * O)``. The dQ kernel mirrors the forward grid
+  (one query block, stream K/V); the dK/dV kernel transposes it
+  (one KV block, stream Q/dO). Probabilities are recomputed as
+  ``exp(s - lse)`` — no second softmax pass, no saved [T, S] matrix.
+- MXU does the matmuls with fp32 accumulation (``preferred_element_type``);
+  VPU does the exp/renormalisation.
+- Causal masking skips *entire* blocks past the diagonal in both directions
+  (loop bounds depend on ``program_id``), and masks only the diagonal block.
 - GQA: the K/V block index map folds the query head onto its KV head, so
-  grouped heads reread the same VMEM block instead of materialising repeats.
+  grouped heads reread the same VMEM block instead of materialising repeats;
+  the backward accumulates per-query-head dK/dV and group-sums outside the
+  kernel.
 
 Falls back to interpret mode off-TPU (tests run it on CPU for bit-accurate
 comparison against the reference einsum path).
@@ -38,11 +46,20 @@ try:  # pltpu only imports on TPU-capable builds; interpret mode needs none of i
 except Exception:  # pragma: no cover
     _VMEM = None
 
+_NEG_INF = -1e30
+#: TPU vector lane count: per-row stats (LSE, delta) are stored broadcast
+#: across one lane tile, the layout Mosaic can store without dynamic
+#: sublane indexing (same scheme as jax.experimental.pallas.ops.tpu).
+_LANES = 128
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float, q_block: int):
-    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, block_q, D]
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, block_k: int, causal: bool, sm_scale: float, q_block: int):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; o_ref: [1, block_q, D];
+    # optional lse_ref: [1, block_q, _LANES] — the FlashAttention-2 residual,
+    # lane-broadcast (TPU tiling forbids (1, bq) blocks).
+    lse_ref = maybe_lse[0] if maybe_lse else None
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
+    q = q_ref[0]  # [bq, D] — native dtype: bf16 operands keep the MXU fast
     seq_len = k_ref.shape[1]
     num_kb = seq_len // block_k
 
@@ -52,12 +69,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_s
         m, l, acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [bk, D]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        s = (
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * sm_scale
+        )  # [bq, bk] fp32
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         blk_max = jnp.max(s, axis=-1)  # [bq]
         new_m = jnp.maximum(m, blk_max)
         correction = jnp.exp(m - new_m)
@@ -70,23 +88,102 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_s
         return new_m, l, acc
 
     d = q_ref.shape[-1]
-    m0 = jnp.full((q_block,), -1e30, jnp.float32)
+    m0 = jnp.full((q_block,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((q_block,), jnp.float32)
     acc0 = jnp.zeros((q_block, d), jnp.float32)
 
-    if causal:
-        # only K blocks up to (and including) the diagonal participate
-        upper = jax.lax.div((qi + 1) * q_block + block_k - 1, block_k)
-        upper = jnp.minimum(upper, num_kb)
-    else:
-        upper = num_kb
+    # only K blocks up to (and including) the diagonal participate
+    upper = _causal_upper(qi, q_block, block_k, num_kb) if causal else num_kb
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None], (q_block, _LANES))
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k: int, causal: bool, sm_scale: float, q_block: int
+):
+    # grid (B*H, T/block_q): one query block, stream K/V — mirrors the forward.
+    # lse_ref/delta_ref: [1, block_q, _LANES], lane-broadcast per-row stats.
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [bq, D] — native dtype operands, fp32 accumulation
+    do = do_ref[0]  # [bq, D]
+    lse = lse_ref[0][:, :1]  # [bq, 1]
+    delta = delta_ref[0][:, :1]  # [bq, 1]
+    seq_len = k_ref.shape[1]
+    num_kb = seq_len // block_k
+    q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 0)
+
+    def body(kb, acc):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]  # [bk, D]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = (
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * sm_scale
+        )  # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk] fp32; masked entries underflow to 0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    upper = _causal_upper(qi, q_block, block_k, num_kb) if causal else num_kb
+    acc0 = jnp.zeros((q_block, q_ref.shape[-1]), jnp.float32)
+    acc = jax.lax.fori_loop(0, upper, body, acc0)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, sm_scale: float, k_block: int
+):
+    # grid (B*H, S/block_k, T/block_q): one KV block accumulates across the
+    # innermost q-block dimension (dk/dv output blocks are revisited — they
+    # stay resident in VMEM until kb advances). Q/dO/stats stream per step,
+    # so VMEM use is O(block) regardless of sequence length.
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    def _accumulate():
+        k = k_ref[0]  # [bk, D] — native dtype operands, fp32 accumulation
+        v = v_ref[0]
+        q = q_ref[0]  # [bq, D]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # [bq, 1]
+        delta = delta_ref[0][:, :1]
+        s = (
+            jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * sm_scale
+        )  # [bq, bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, k_block), 0)
+            k_pos = kb * k_block + jax.lax.broadcasted_iota(jnp.int32, (block_q, k_block), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk] fp32
+        dv_ref[0] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dk_ref[0] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(dk_ref.dtype)
+
+    if causal:
+        # skip q blocks entirely above the diagonal (their p is all zero)
+        pl.when((qb + 1) * block_q - 1 >= kb * k_block)(_accumulate)
+    else:
+        _accumulate()
 
 
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
-    """Unfused GQA attention (fp32 softmax) — the backward-pass recompute path
-    and the numerical reference for tests."""
+    """Unfused GQA attention (fp32 softmax) — the numerical reference for tests."""
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -94,7 +191,7 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * sm_scale
     if causal:
         mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(b, t, h, d)
@@ -106,22 +203,30 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0. Returns [B, T, H, D].
 
     Sequence lengths must be multiples of the block sizes (pad upstream);
-    block sizes auto-shrink for short sequences. Differentiable: the backward
-    pass recomputes attention flash-style (activations are never saved), via
-    ``jax.custom_vjp``.
+    block sizes auto-shrink for short sequences. Differentiable end-to-end in
+    Pallas: the forward saves only O and the per-row logsumexp, and the
+    backward recomputes probabilities flash-style in two kernels (dQ;
+    dK/dV) — activations never materialise in HBM.
     """
     b, t, h, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if causal and t != k.shape[1]:
+        # the kernels mask with top-left alignment (q_pos >= k_pos); a
+        # KV-cache-style bottom-right alignment for T != S is a different
+        # mask — reject instead of silently attending to the wrong keys
+        raise ValueError(
+            f"causal flash attention requires equal Q/KV sequence lengths, got {t} != {k.shape[1]}"
+        )
     return _flash(q, k, v, causal, float(sm_scale), min(block_q, t), min(block_k, k.shape[1]), bool(interpret))
 
 
@@ -131,53 +236,148 @@ def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    # Recompute-based backward: O(1) saved activations. A dedicated Pallas
-    # backward kernel can replace this without touching the public API.
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _fold_heads(x):
+    """[B, T, H, D] -> [B*H, T, D] (grid leading axis = one (batch, head))."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _make_kv_index(h: int, kh: int):
+    """Block index map folding a query head onto its KV head (GQA) — shared
+    by the forward and both backward pallas_calls so the folding can never
+    desynchronise."""
+    group = h // kh
+
+    def kv_index(bh, *_):
+        return (bh // h) * kh + (bh % h) // group
+
+    return kv_index
+
+
+def _causal_upper(qi, q_block: int, block_k: int, num_kb: int):
+    """Exclusive K-block bound for a query block under top-left causal
+    alignment — K blocks fully past the diagonal never run."""
+    upper = jax.lax.div((qi + 1) * q_block + block_k - 1, block_k)
+    return jnp.minimum(upper, num_kb)
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=False):
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     if h % kh:
         raise ValueError(f"query heads {h} not a multiple of kv heads {kh}")
-    group = h // kh
     if t % block_q or s % block_k:
         raise ValueError(f"seq lens ({t}, {s}) must be multiples of block sizes ({block_q}, {block_k})")
 
-    # [B, T, H, D] -> [B*H, T, D] so the grid's leading axis is one (batch, head)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
-
-    def kv_index(bh, qi):
-        return (bh // h) * kh + (bh % h) // group
+    qt = _fold_heads(q)
+    kt = _fold_heads(k)
+    vt = _fold_heads(v)
+    kv_index = _make_kv_index(h, kh)
 
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q
     )
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem)]
+    if with_residuals:
+        out_shape.append(jax.ShapeDtypeStruct((b * h, t, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, _LANES), lambda bh, qi: (bh, qi, 0), **vmem))
+    results = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=out_shape,
         grid=(b * h, t // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),
             pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),
             pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),
+        out_specs=out_specs,
         interpret=interpret,
     )(qt, kt, vt)
 
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = results[0].reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if with_residuals:
+        # slim the residual to [B*H, T]: the lane-broadcast copy need not
+        # live for the whole backward graph
+        return out, results[1][:, :, 0]
+    return out
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    group = h // kh
+
+    qt = _fold_heads(q)
+    kt = _fold_heads(k)
+    vt = _fold_heads(v)
+    dot = _fold_heads(g)
+    ot = _fold_heads(out)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term;
+    # stats enter the kernels lane-broadcast ([B*H, T, _LANES], TPU tiling)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)  # [B*H, T]
+    delta3 = jnp.broadcast_to(delta[:, :, None], (b * h, t, _LANES))
+    lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, t, _LANES))
+    kv_index = _make_kv_index(h, kh)
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),  # q
+            pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),  # k
+            pl.BlockSpec((1, s, d), lambda bh, qi: (kv_index(bh, qi), 0, 0), **vmem),  # v
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),  # dO
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi: (bh, qi, 0), **vmem),  # lse
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi: (bh, qi, 0), **vmem),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **vmem),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse3, delta3)
+
+    # per-query-head dK/dV; group-summed below for GQA. 3D grid: the q-block
+    # axis is innermost so dk/dv output blocks accumulate in VMEM.
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale, k_block=block_k),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        ],
+        grid=(b * h, s // block_k, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0), **vmem),  # q
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # k
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # v
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, qb, 0), **vmem),  # dO
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, qb, 0), **vmem),  # lse
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, qb, 0), **vmem),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0), **vmem),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0), **vmem),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse3, delta3)
+
+    dq = dq.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    dk = dk_h.reshape(b, kh, group, s, d).sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_h.reshape(b, kh, group, s, d).sum(axis=2).transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv

@@ -436,6 +436,7 @@ class TrainValStage(Stage):
             return
         ckpt.save_state(completed, self._state_pytree(), scope=self.name)
         if is_root():
+            import os
             import pickle
 
             meta_dir = ckpt.path / "meta" / self.name
@@ -445,7 +446,11 @@ class TrainValStage(Stage):
                 "stopped": self._stop_requested,
                 "tracker": self.tracker.state_dict(),
             }
-            (meta_dir / f"{completed}.pkl").write_bytes(pickle.dumps(meta))
+            # atomic write: a preemption mid-write must not leave a truncated
+            # sidecar that breaks the very resume it exists for
+            tmp = meta_dir / f".{completed}.pkl.tmp"
+            tmp.write_bytes(pickle.dumps(meta))
+            os.replace(tmp, meta_dir / f"{completed}.pkl")
             # keep sidecars in lockstep with Orbax retention (max_to_keep)
             kept = set(ckpt.state_manager(self.name).all_steps()) | {completed}
             for f in meta_dir.glob("*.pkl"):
@@ -462,10 +467,18 @@ class TrainValStage(Stage):
         restored = ckpt.restore_state(latest, template=self._state_pytree(), scope=self.name)
         self.state = self.state.replace(**restored)
         meta_file = ckpt.path / "meta" / self.name / f"{latest}.pkl"
+        meta = None
         if meta_file.exists():
             import pickle
 
-            meta = pickle.loads(meta_file.read_bytes())
+            try:
+                meta = pickle.loads(meta_file.read_bytes())
+            except Exception:
+                self.logger.warning(
+                    f"Corrupt resume metadata {meta_file}; continuing from the Orbax step alone "
+                    "(metric history and early-stop flag are lost)"
+                )
+        if meta is not None:
             self.tracker.load_state_dict(meta["tracker"])
             self.current_epoch = int(meta["epoch"]) + 1
             # a stage that had already stopped early must not re-train

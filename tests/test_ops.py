@@ -40,6 +40,18 @@ class TestFlashAttention:
         with pytest.raises(ValueError):
             flash_attention(q, k, v, block_q=64, block_k=64)
 
+    def test_causal_cross_length_rejected(self):
+        """Causal with T != S would silently use the wrong mask alignment —
+        must raise, not return top-left-masked garbage."""
+        q, _, _ = _qkv(t=64, h=2, d=16)
+        _, k, v = _qkv(t=128, h=2, d=16, seed=1)
+        with pytest.raises(ValueError, match="equal Q/KV sequence lengths"):
+            flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        # non-causal cross-length is fine
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+        expected = _dot_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
     def test_grad_flows(self):
         q, k, v = _qkv(t=64, h=2, d=16)
 
@@ -49,6 +61,68 @@ class TestFlashAttention:
         g = jax.grad(loss)(q)
         assert g.shape == q.shape
         assert bool(jnp.all(jnp.isfinite(g)))
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_backward_matches_reference(self, causal):
+        """The Pallas backward kernels (dQ; dK/dV) against autodiff through
+        the reference einsum path — multi-block grids in both directions."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        q, k, v = _qkv(t=128, h=4, d=32)
+        cot = jnp.asarray(np.random.RandomState(7).randn(*q.shape), q.dtype)
+
+        def flash_loss(q, k, v):
+            return jnp.vdot(flash_attention(q, k, v, causal=causal, block_q=32, block_k=64), cot)
+
+        def ref_loss(q, k, v):
+            return jnp.vdot(_reference_attention(q, k, v, causal, 1.0 / np.sqrt(q.shape[-1])), cot)
+
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_backward_gqa_matches_reference(self):
+        """GQA backward: grouped heads must accumulate into shared dK/dV."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        q, k, v = _qkv(t=64, h=8, kh=2, d=16)
+        cot = jnp.asarray(np.random.RandomState(8).randn(*q.shape), q.dtype)
+
+        def flash_loss(q, k, v):
+            return jnp.vdot(flash_attention(q, k, v, causal=True, block_q=32, block_k=32), cot)
+
+        def ref_loss(q, k, v):
+            return jnp.vdot(_reference_attention(q, k, v, True, 1.0 / np.sqrt(q.shape[-1])), cot)
+
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_backward_uneven_qk_blocks(self):
+        """block_q != block_k exercises the diagonal-skip bounds in both
+        backward kernels (dq upper bound, dkv lower bound)."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        q, k, v = _qkv(t=128, h=2, d=16, seed=3)
+
+        def flash_loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64, block_k=16) ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(_reference_attention(q, k, v, True, 1.0 / np.sqrt(q.shape[-1])) ** 2)
+
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
 
 
 class TestRingAttention:

@@ -143,6 +143,22 @@ def test_two_unnamed_stages_get_distinct_scopes(tmp_path, single_runtime):
     pipeline.checkpoint_dir.close()
 
 
+def test_corrupt_meta_sidecar_still_resumes(tmp_path, single_runtime):
+    """A truncated metadata pickle (crash mid-write) must degrade to
+    Orbax-only resume, not kill the resumed run."""
+    p1, _ = _run(tmp_path / "c", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    for f in meta_dir.glob("*.pkl"):
+        f.write_bytes(f.read_bytes()[: len(f.read_bytes()) // 2])  # truncate
+
+    p2, s2 = _run(tmp_path / "c", resume_from=run_dir, max_epochs=4)
+    assert p2.resumed is True
+    assert s2.current_epoch == 5  # resumed from Orbax step 2, ran 3..4
+    p2.checkpoint_dir.close()
+
+
 def test_checkpoint_every_zero_disables_state_saves(tmp_path, single_runtime):
     class NoCkptStage(_ToyStage):
         def checkpoint_every(self):
