@@ -163,17 +163,20 @@ class MetricReducer:
 
     # -- serialization ------------------------------------------------------
     def state_dict(self) -> dict:
+        # reduction stored by value so the state is JSON-encodable (resume
+        # sidecars are JSON, not pickle — utils/serialization.py)
         return {
-            "reduction": self.reduction,
+            "reduction": self.reduction.value,
             "dim": self.dim,
             "globally": self.globally,
             "values": [_to_host(v) for v in self.values],
         }
 
     def load_state_dict(self, state: dict) -> None:
-        self.reduction = state["reduction"]
+        red = state["reduction"]
+        self.reduction = red if isinstance(red, Reduction) else Reduction(red)
         self.dim = state["dim"]
-        self.globally = state["globally"]
+        self.globally = bool(state["globally"])
         self.values = list(state["values"])
 
 
@@ -332,7 +335,7 @@ class MetricTracker:
         }
 
     def load_state_dict(self, state: dict) -> None:
-        self.epoch = state["epoch"]
+        self.epoch = int(state["epoch"])
         self.histories = {k: list(v) for k, v in state["histories"].items()}
         self.reducers = {}
         for name, rstate in state["reducers"].items():

@@ -182,6 +182,20 @@ def _dkv_kernel(
         _accumulate()
 
 
+def _auto_block(requested: int, seq: int) -> int:
+    """Largest block <= requested that divides ``seq`` (halving: 256->128->64),
+    so default block sizes serve any seq len that is a multiple of 64 — a
+    384-token sequence gets 128-blocks instead of an error. Never shrinks
+    below 64 (or below an explicit smaller request): a seq len not divisible
+    by 64 still raises, instead of silently degrading to a tile too small
+    for the MXU — pad upstream."""
+    blk = min(requested, seq)
+    floor = min(requested, 64)
+    while blk > floor and seq % blk:
+        blk //= 2
+    return blk
+
+
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     """Unfused GQA attention (fp32 softmax) — the numerical reference for tests."""
     b, t, h, d = q.shape
@@ -227,7 +241,9 @@ def flash_attention(
         raise ValueError(
             f"causal flash attention requires equal Q/KV sequence lengths, got {t} != {k.shape[1]}"
         )
-    return _flash(q, k, v, causal, float(sm_scale), min(block_q, t), min(block_k, k.shape[1]), bool(interpret))
+    return _flash(
+        q, k, v, causal, float(sm_scale), _auto_block(block_q, t), _auto_block(block_k, k.shape[1]), bool(interpret)
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -23,6 +23,7 @@ semantics exactly (replicated params, batch split, mean-reduced grads).
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 from typing import Any, Callable, Mapping, Sequence
@@ -30,6 +31,8 @@ from typing import Any, Callable, Mapping, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_logger = logging.getLogger("dmlcloud_tpu")
 
 DATA, FSDP, MODEL, SEQ, EXPERT, PIPE = "data", "fsdp", "model", "seq", "expert", "pipe"
 
@@ -209,11 +212,14 @@ def make_param_policy(policy: str | PartitionRules | Callable[[str, Any], P]) ->
                         for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
                             if cleaned[i] is None and shape[i] % n == 0 and shape[i] >= 2 * n:
                                 cleaned[i] = a
+                                _logger.info(
+                                    "param %s: axis %r (size %d) does not divide its rule dim; "
+                                    "relocated to dim %d of shape %s",
+                                    path, a, n, i, tuple(shape),
+                                )
                                 break
                         else:
-                            import logging
-
-                            logging.getLogger("dmlcloud_tpu").warning(
+                            _logger.warning(
                                 "param %s: no dim of shape %s divisible by axis %r "
                                 "(size %d); leaving that axis unsharded (replicated)",
                                 path, tuple(shape), a, n,

@@ -21,6 +21,7 @@ timeout, diagnostics — re-based on the TPU runtime:
 from __future__ import annotations
 
 import logging
+import re
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Callable, Optional
@@ -181,6 +182,13 @@ class TrainingPipeline:
         # same-class stages get a numeric suffix.
         existing = {s.name for s in self.stages}
         if name is not None:
+            # the name keys filesystem paths (state/<name>, meta/<name>); an
+            # unconstrained string like "../other" would escape the checkpoint dir
+            if not re.fullmatch(r"[A-Za-z0-9._-]+", name) or name in (".", ".."):
+                raise ValueError(
+                    f"Stage name {name!r} is invalid: must match [A-Za-z0-9._-]+ "
+                    "(it names checkpoint subdirectories)"
+                )
             if name in existing:
                 raise ValueError(f"Stage with name {name!r} already exists")
             stage.name = name

@@ -35,6 +35,7 @@ import numpy as np
 
 from .metrics import MetricTracker, Reduction
 from .parallel import mesh as mesh_lib
+from .parallel import runtime
 from .parallel.runtime import is_root
 from .train_state import TrainState
 from .utils.logging import DevNullIO, flush_log_handlers
@@ -436,26 +437,82 @@ class TrainValStage(Stage):
             return
         ckpt.save_state(completed, self._state_pytree(), scope=self.name)
         if is_root():
+            import json
             import os
-            import pickle
+
+            from .utils.serialization import to_jsonable
 
             meta_dir = ckpt.path / "meta" / self.name
             meta_dir.mkdir(parents=True, exist_ok=True)
+            try:
+                tracker_state = to_jsonable(self.tracker.state_dict())
+            except TypeError as e:
+                # a non-numeric tracked value must not kill the run at save
+                # time (worse: only root would die, the other hosts would hang
+                # in the next collective) — save epoch/stop without history
+                self.logger.warning(
+                    f"Metric tracker state is not JSON-encodable ({e}); saving resume "
+                    "metadata without metric history"
+                )
+                tracker_state = None
             meta = {
                 "epoch": completed,
                 "stopped": self._stop_requested,
-                "tracker": self.tracker.state_dict(),
+                "tracker": tracker_state,
             }
             # atomic write: a preemption mid-write must not leave a truncated
             # sidecar that breaks the very resume it exists for
-            tmp = meta_dir / f".{completed}.pkl.tmp"
-            tmp.write_bytes(pickle.dumps(meta))
-            os.replace(tmp, meta_dir / f"{completed}.pkl")
-            # keep sidecars in lockstep with Orbax retention (max_to_keep)
+            tmp = meta_dir / f".{completed}.json.tmp"
+            tmp.write_text(json.dumps(meta))
+            os.replace(tmp, meta_dir / f"{completed}.json")
+            # keep sidecars in lockstep with Orbax retention (max_to_keep);
+            # *.pkl covers sidecars from the pre-JSON format
             kept = set(ckpt.state_manager(self.name).all_steps()) | {completed}
-            for f in meta_dir.glob("*.pkl"):
+            for f in list(meta_dir.glob("*.json")) + list(meta_dir.glob("*.pkl")):
                 if f.stem.isdigit() and int(f.stem) not in kept:
                     f.unlink(missing_ok=True)
+
+    def _read_resume_meta(self, step: int) -> dict | None:
+        """Root-only: read + validate the JSON resume sidecar for ``step``.
+        Returns None (with a logged warning) on a missing/corrupt/ill-typed
+        file — the caller degrades to Orbax-only resume."""
+        import json
+
+        from .utils.serialization import from_jsonable
+
+        meta_file = self.pipeline.checkpoint_dir.path / "meta" / self.name / f"{step}.json"
+        try:
+            raw = json.loads(meta_file.read_text())
+            meta = {
+                "epoch": int(raw["epoch"]),
+                "stopped": bool(raw["stopped"]),
+                "tracker": from_jsonable(raw["tracker"]),
+            }
+            if meta["tracker"] is not None:
+                # full validation: load into a throwaway tracker so a
+                # structurally incomplete sidecar degrades here (to
+                # Orbax-only resume) instead of crashing the real restore
+                MetricTracker().load_state_dict(meta["tracker"])
+            return meta
+        except FileNotFoundError:
+            legacy = meta_file.with_suffix(".pkl")
+            if legacy.exists():
+                self.logger.warning(
+                    f"Found legacy pickle resume sidecar {legacy}; it is ignored (pickle "
+                    "loading executes arbitrary code). Metric history and early-stop flag "
+                    "start fresh; training state itself is fully restored from Orbax."
+                )
+            else:
+                self.logger.warning(
+                    f"No resume metadata at {meta_file}; continuing from the Orbax step alone "
+                    "(metric history and early-stop flag are lost)"
+                )
+        except Exception:
+            self.logger.warning(
+                f"Corrupt resume metadata {meta_file}; continuing from the Orbax step alone "
+                "(metric history and early-stop flag are lost)"
+            )
+        return None
 
     def _restore_state(self):
         ckpt = self.pipeline.checkpoint_dir
@@ -466,23 +523,20 @@ class TrainValStage(Stage):
             return  # e.g. crash before this stage's first save
         restored = ckpt.restore_state(latest, template=self._state_pytree(), scope=self.name)
         self.state = self.state.replace(**restored)
-        meta_file = ckpt.path / "meta" / self.name / f"{latest}.pkl"
-        meta = None
-        if meta_file.exists():
-            import pickle
-
-            try:
-                meta = pickle.loads(meta_file.read_bytes())
-            except Exception:
-                self.logger.warning(
-                    f"Corrupt resume metadata {meta_file}; continuing from the Orbax step alone "
-                    "(metric history and early-stop flag are lost)"
-                )
+        # The root alone reads and validates the sidecar, then broadcasts the
+        # resolved (epoch, stopped, tracker) — if every process read its own
+        # copy, a corrupt/missing file on SOME hosts would leave them with
+        # different epoch counters and stop flags, so some hosts enter the
+        # epoch loop's collectives while others skip it: divergence, then
+        # deadlock. Same root-decides pattern as enable_checkpointing.
+        meta = self._read_resume_meta(latest) if is_root() else None
+        meta = runtime.broadcast_object(meta)
         if meta is not None:
-            self.tracker.load_state_dict(meta["tracker"])
-            self.current_epoch = int(meta["epoch"]) + 1
+            if meta["tracker"] is not None:
+                self.tracker.load_state_dict(meta["tracker"])
+            self.current_epoch = meta["epoch"] + 1
             # a stage that had already stopped early must not re-train
-            self._stop_requested = bool(meta.get("stopped", False))
+            self._stop_requested = meta["stopped"]
         else:
             self.current_epoch = latest + 1
         self.logger.info(

@@ -40,6 +40,15 @@ class TestFlashAttention:
         with pytest.raises(ValueError):
             flash_attention(q, k, v, block_q=64, block_k=64)
 
+    @pytest.mark.parametrize("t", [384, 192])
+    def test_default_blocks_auto_shrink(self, t):
+        """Seq lens that are multiples of 128/64 but not of the default 256
+        block must auto-select the largest dividing block, not raise."""
+        q, k, v = _qkv(t=t, h=2, d=16)
+        expected = _dot_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True)  # default block sizes
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
     def test_causal_cross_length_rejected(self):
         """Causal with T != S would silently use the wrong mask alignment —
         must raise, not return top-left-masked garbage."""

@@ -144,19 +144,98 @@ def test_two_unnamed_stages_get_distinct_scopes(tmp_path, single_runtime):
 
 
 def test_corrupt_meta_sidecar_still_resumes(tmp_path, single_runtime):
-    """A truncated metadata pickle (crash mid-write) must degrade to
+    """A truncated metadata sidecar (crash mid-write) must degrade to
     Orbax-only resume, not kill the resumed run."""
     p1, _ = _run(tmp_path / "c", max_epochs=2)
     run_dir = str(p1.checkpoint_dir)
     p1.checkpoint_dir.close()
     meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
-    for f in meta_dir.glob("*.pkl"):
-        f.write_bytes(f.read_bytes()[: len(f.read_bytes()) // 2])  # truncate
+    corrupted = 0
+    for f in meta_dir.glob("*.json"):
+        f.write_text(f.read_text()[: len(f.read_text()) // 2])  # truncate
+        corrupted += 1
+    assert corrupted > 0  # the sidecars must actually exist to be corrupted
 
     p2, s2 = _run(tmp_path / "c", resume_from=run_dir, max_epochs=4)
     assert p2.resumed is True
     assert s2.current_epoch == 5  # resumed from Orbax step 2, ran 3..4
     p2.checkpoint_dir.close()
+
+
+def test_missing_meta_sidecar_still_resumes(tmp_path, single_runtime):
+    p1, _ = _run(tmp_path / "m", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    for f in meta_dir.glob("*.json"):
+        f.unlink()
+
+    p2, s2 = _run(tmp_path / "m", resume_from=run_dir, max_epochs=4)
+    assert p2.resumed is True
+    assert s2.current_epoch == 5
+    p2.checkpoint_dir.close()
+
+
+def test_sidecar_is_json_not_pickle(tmp_path, single_runtime):
+    """The resume sidecar must be plain JSON — loading a checkpoint dir must
+    never execute code from it (pickle did)."""
+    import json
+
+    p1, _ = _run(tmp_path / "j", max_epochs=1)
+    p1.checkpoint_dir.close()
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    files = sorted(meta_dir.glob("*"))
+    assert files and all(f.suffix == ".json" for f in files)
+    meta = json.loads(files[-1].read_text())
+    assert meta["epoch"] == 1
+    assert meta["stopped"] is False
+    assert "histories" in meta["tracker"]
+
+
+def test_structurally_invalid_sidecar_degrades(tmp_path, single_runtime):
+    """A sidecar that parses as JSON but has an incomplete tracker state must
+    degrade to Orbax-only resume, not crash in load_state_dict."""
+    import json
+
+    p1, _ = _run(tmp_path / "v", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    for f in meta_dir.glob("*.json"):
+        f.write_text(json.dumps({"epoch": 2, "stopped": False, "tracker": {"histories": {}}}))
+
+    p2, s2 = _run(tmp_path / "v", resume_from=run_dir, max_epochs=4)
+    assert p2.resumed is True
+    assert s2.current_epoch == 5
+    p2.checkpoint_dir.close()
+
+
+def test_legacy_pickle_sidecar_ignored(tmp_path, single_runtime):
+    """Pre-JSON checkpoints carry .pkl sidecars; resume must NOT unpickle them
+    (code execution) — it degrades to Orbax-only with a warning."""
+    p1, _ = _run(tmp_path / "p", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    for f in meta_dir.glob("*.json"):
+        # a malicious pickle would execute on load; here any bytes prove
+        # the file is never opened by the unpickler (it would raise)
+        f.with_suffix(".pkl").write_bytes(b"\x80\x04never loaded")
+        f.unlink()
+
+    p2, s2 = _run(tmp_path / "p", resume_from=run_dir, max_epochs=4)
+    assert p2.resumed is True
+    assert s2.current_epoch == 5
+    p2.checkpoint_dir.close()
+
+
+@pytest.mark.parametrize("bad", ["../escape", "a/b", "", ".", "..", "name with space"])
+def test_invalid_stage_name_rejected(single_runtime, bad):
+    """Stage names key checkpoint subdirectories (state/<name>, meta/<name>);
+    path separators and dot-dirs must be rejected."""
+    pipeline = dml.TrainingPipeline(name="badname")
+    with pytest.raises(ValueError, match="invalid"):
+        pipeline.append_stage(_ToyStage(), max_epochs=1, name=bad)
 
 
 def test_checkpoint_every_zero_disables_state_saves(tmp_path, single_runtime):

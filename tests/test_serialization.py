@@ -1,0 +1,89 @@
+"""JSON sidecar encoding: numeric pytrees must round-trip exactly through
+to_jsonable -> json -> from_jsonable (dtype, shape, NaN included), and the
+encoding must refuse non-string keys instead of corrupting silently."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.metrics import MetricTracker, Reduction
+from dmlcloud_tpu.utils.serialization import from_jsonable, to_jsonable
+
+
+def _roundtrip(obj):
+    return from_jsonable(json.loads(json.dumps(to_jsonable(obj))))
+
+
+class TestRoundtrip:
+    def test_scalars_and_none(self):
+        obj = {"a": 1, "b": 2.5, "c": True, "d": None, "e": "text"}
+        assert _roundtrip(obj) == obj
+
+    def test_numpy_scalar_keeps_dtype(self):
+        out = _roundtrip(np.float32(1.5))
+        assert out == np.float32(1.5)
+        assert out.dtype == np.float32
+
+    def test_ndarray_keeps_dtype_and_shape(self):
+        arr = np.arange(12, dtype=np.int16).reshape(3, 4)
+        out = _roundtrip(arr)
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype
+
+    def test_zero_dim_and_empty_arrays(self):
+        zd = np.array(3.0)
+        out = _roundtrip(zd)
+        assert out.shape == () and out == 3.0
+        empty = np.zeros((0, 3), dtype=np.float64)
+        out = _roundtrip(empty)
+        assert out.shape == (0, 3)
+
+    def test_nan_and_inf(self):
+        arr = np.array([np.nan, np.inf, -np.inf])
+        out = _roundtrip(arr)
+        assert np.isnan(out[0]) and np.isinf(out[1]) and out[2] == -np.inf
+
+    def test_nested_lists_and_tuples(self):
+        out = _roundtrip({"h": [(1, 2), None, np.float64(3.0)]})
+        assert out["h"][0] == [1, 2]
+        assert out["h"][1] is None
+        assert out["h"][2] == 3.0
+
+    def test_non_string_keys_rejected(self):
+        with pytest.raises(TypeError, match="str keys"):
+            to_jsonable({1: "x"})
+
+    def test_unsupported_dtypes_raise_not_recurse(self):
+        """Exotic numpy types must fail with a clear TypeError — not
+        RecursionError (scalars) or un-dumpable output (object arrays)."""
+        for bad in (np.complex64(1 + 2j), np.datetime64("2026-01-01"), np.array([object()])):
+            with pytest.raises(TypeError, match="not JSON-encodable"):
+                to_jsonable(bad)
+
+    def test_tag_collision_rejected(self):
+        with pytest.raises(TypeError, match="collides"):
+            to_jsonable({"__ndarray__": [1]})
+
+
+class TestTrackerStateJson:
+    def test_tracker_state_roundtrips_through_json(self, single_runtime):
+        t = MetricTracker()
+        t.register_metric("loss", Reduction.MEAN)
+        t.register_metric("note")
+        t.track("loss", np.float32(0.5))
+        t.track("note", 7)
+        t.next_epoch()
+        t.register_metric("acc", Reduction.MAX)
+        t.track("acc", np.array([0.1, 0.9]))
+
+        state = _roundtrip(t.state_dict())
+        t2 = MetricTracker()
+        t2.load_state_dict(state)
+        assert t2.epoch == t.epoch
+        assert t2["loss"] == [np.float32(0.5)]
+        assert t2.reducers["acc"].reduction is Reduction.MAX
+        # buffered (unreduced) values survive too
+        assert len(t2.reducers["acc"].values) == 1
+        t2.next_epoch()
+        assert t2["acc"][-1] == pytest.approx(0.9)
