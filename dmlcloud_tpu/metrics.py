@@ -12,11 +12,14 @@ TPU-first redesigns:
 
 2. **One fused collective per epoch.** The reference issues one
    ``all_gather_object`` (emptiness consensus) plus one ``all_reduce`` *per
-   metric per epoch* (metrics.py:121-141) — 2·N collectives. Here
-   ``MetricTracker.reduce_all`` ships every metric's locally-reduced value and
-   emptiness bit in ONE control-plane exchange and combines on host
-   (``_reduce_globally_fused``), so epoch-end sync cost is O(1) in the number
-   of metrics. This is the "metrics allreduce" latency target of BASELINE.md.
+   metric per epoch* (metrics.py:121-141) — 2·N collectives over gloo. Here
+   ``MetricTracker.reduce_all`` packs every scalar metric's locally-reduced
+   value, emptiness bit, and a name-set fingerprint into ONE float32 vector,
+   allgathers it in a single XLA collective over ICI/DCN
+   (``runtime.all_gather_array``), and combines on host — epoch-end sync cost
+   is O(1) in the number of metrics and never touches the KV store. This is
+   the "metrics allreduce" latency target of BASELINE.md. Non-scalar metrics
+   (rare) fall back to one object exchange with concurrent fetches.
 
 The ragged-tracking consensus error (some ranks tracked a metric, some did
 not — a symptom of diverged control flow; reference metrics.py:124-130) is
@@ -188,6 +191,55 @@ def _combine_across(per_rank: list[np.ndarray], reduction: Reduction) -> np.ndar
     return reduction.combine(stacked, axis=0)
 
 
+def _name_fingerprint(names: list[str]) -> np.float32:
+    """Order-sensitive fingerprint of the metric-name set, packed into the
+    exchange vector so ranks that diverged on WHICH metrics they track get a
+    diagnostic instead of silently combining mismatched columns. The modulus
+    keeps the value exactly representable in float32."""
+    import zlib
+
+    return np.float32(zlib.crc32("\x00".join(names).encode()) % (2**24 - 3))
+
+
+def _pack_scalar_metrics(names: list[str], local: dict[str, tuple[bool, Any]]) -> np.ndarray:
+    """``[fingerprint | empty bits | values]`` as one float32 vector — the
+    payload of the single-collective epoch exchange."""
+    n = len(names)
+    vec = np.zeros(1 + 2 * n, np.float32)
+    vec[0] = _name_fingerprint(names)
+    for i, name in enumerate(names):
+        empty, val = local[name]
+        vec[1 + i] = 1.0 if empty else 0.0
+        if not empty:
+            vec[1 + n + i] = np.float32(val)
+    return vec
+
+
+def _unpack_scalar_metrics(
+    names: list[str], gathered: np.ndarray, reductions: dict[str, Reduction]
+) -> dict[str, np.ndarray | None]:
+    """Combine the ``[world, 1+2n]`` gathered exchange vectors on host,
+    preserving the reference's ragged-tracking diagnostics (metrics.py:124-130)."""
+    n = len(names)
+    if not np.all(gathered[:, 0] == gathered[0, 0]):
+        raise ValueError(
+            "Workers disagree on the set of metrics tracked this epoch. This is likely a bug."
+        )
+    out: dict[str, np.ndarray | None] = {}
+    for i, name in enumerate(names):
+        empties = gathered[:, 1 + i] != 0.0
+        if empties.any():
+            if not empties.all():
+                raise ValueError(
+                    f"Metric '{name}': some workers tracked values this epoch and some did not. "
+                    "This is likely a bug."
+                )
+            out[name] = None
+        else:
+            out[name] = _combine_across(list(gathered[:, 1 + n + i]), reductions[name])
+    return out
+
+
 class MetricTracker:
     """Tracks named metric histories keyed by epoch.
 
@@ -288,24 +340,43 @@ class MetricTracker:
             if reducer is not None and reducer.globally:
                 local[name] = (len(reducer.values) == 0, reducer.reduce_locally())
 
-        # Phase 2: one fused exchange for all globally-reduced metrics.
+        # Phase 2: cross-process exchange. Scalar metrics (the overwhelming
+        # common case) ride ONE XLA collective over ICI as a packed float32
+        # vector — zero KV-store round trips; non-scalar metrics fall back to
+        # one object exchange over the coordination service (with concurrent
+        # fetches). Caveat of the packed path: values transit as float32, so
+        # integer SUM counters are exact up to 2**24 per epoch.
         fused: dict[str, np.ndarray | None] = {}
         if local and runtime.world_size() > 1:
-            gathered = runtime.all_gather_object(local)  # list over ranks
-            for name in local:
-                # a rank that never registered the metric counts as "empty" so
-                # the ragged-tracking diagnostic below fires instead of KeyError
-                empties = [g.get(name, (True, None))[0] for g in gathered]
-                if any(empties):
-                    if not all(empties):
-                        raise ValueError(
-                            f"Metric '{name}': some workers tracked values this epoch and some did not. "
-                            "This is likely a bug."
-                        )
-                    fused[name] = None
-                else:
-                    reducer = self.reducers[name]
-                    fused[name] = _combine_across([g[name][1] for g in gathered], reducer.reduction)
+            # Scalar = registered with dim=None (full reduction), which is a
+            # REGISTRATION-time property — classifying by the runtime value's
+            # shape would let an empty buffer on one rank route the same
+            # metric through different exchanges on different ranks, turning
+            # the ragged-tracking diagnostic into a collective shape
+            # mismatch. dim=None guarantees a scalar local reduction.
+            scalar_names = sorted(n for n in local if self.reducers[n].dim is None)
+            other = {n: local[n] for n in local if n not in scalar_names}
+            if scalar_names:
+                packed = _pack_scalar_metrics(scalar_names, local)
+                gathered = runtime.all_gather_array(packed)
+                reductions = {n: self.reducers[n].reduction for n in scalar_names}
+                fused.update(_unpack_scalar_metrics(scalar_names, gathered, reductions))
+            if other:
+                gathered_obj = runtime.all_gather_object(other)  # list over ranks
+                for name in other:
+                    # a rank that never registered the metric counts as "empty" so
+                    # the ragged-tracking diagnostic below fires instead of KeyError
+                    empties = [g.get(name, (True, None))[0] for g in gathered_obj]
+                    if any(empties):
+                        if not all(empties):
+                            raise ValueError(
+                                f"Metric '{name}': some workers tracked values this epoch and some did not. "
+                                "This is likely a bug."
+                            )
+                        fused[name] = None
+                    else:
+                        reducer = self.reducers[name]
+                        fused[name] = _combine_across([g[name][1] for g in gathered_obj], reducer.reduction)
         else:
             for name, (is_empty, val) in local.items():
                 fused[name] = None if is_empty else val

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 import jax
+import numpy as np
 
 from ..utils import slurm as _slurm
 from ..utils.tcp import find_free_port, get_local_ips
@@ -365,13 +366,46 @@ def _client():
 _seq = {"barrier": 0, "obj": 0}
 
 
+class BarrierTimeout(RuntimeError):
+    """A barrier timed out; ``stragglers`` lists the ranks that never arrived
+    (parity with the reference's ``monitored_barrier(wait_all_ranks=True)``,
+    pipeline.py:191-196, which names late ranks)."""
+
+    def __init__(self, tag: str, timeout: float, stragglers: list[int]):
+        self.tag = tag
+        self.timeout = timeout
+        self.stragglers = stragglers
+        super().__init__(
+            f"barrier '{tag}' timed out after {timeout:.0f}s; "
+            f"straggler ranks (never arrived): {stragglers or 'unknown'}"
+        )
+
+
+def _find_stragglers(client, barrier_id: str, probe_timeout_ms: int = 200) -> list[int]:
+    """Ranks whose arrival key for ``barrier_id`` is absent — probed
+    concurrently with short blocking gets."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def probe(src: int) -> int | None:
+        try:
+            client.blocking_key_value_get(f"{barrier_id}/arrived/{src}", probe_timeout_ms)
+            return None
+        except Exception:
+            return src
+
+    with ThreadPoolExecutor(max_workers=min(world_size(), 32)) as ex:
+        return [r for r in ex.map(probe, range(world_size())) if r is not None]
+
+
 def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
-    """All-process barrier with real timeout semantics.
+    """All-process barrier with real timeout semantics that NAMES stragglers.
 
     The reference uses gloo ``monitored_barrier(wait_all_ranks=True)``
-    (pipeline.py:191-196) to catch stragglers; here the coordination service's
-    ``wait_at_barrier`` provides the same guarantee — it raises on timeout and
-    reports which barrier id timed out. Control-plane only: no device traffic.
+    (pipeline.py:191-196), whose timeout error lists the late ranks. Here
+    every process drops a per-rank arrival key into the coordination-service
+    KV store before waiting; on timeout the error reports exactly which ranks
+    never arrived (``BarrierTimeout.stragglers``). Control-plane only: no
+    device traffic.
     """
     if world_size() <= 1:
         return
@@ -379,7 +413,19 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
     _seq["barrier"] += 1
     barrier_id = f"dmlcloud_tpu:{tag}:{_seq['barrier']}"
     if client is not None:
-        client.wait_at_barrier(barrier_id, timeout_in_ms=int(timeout * 1000))
+        # Arrival keys are never deleted: a rank that passed the barrier and
+        # retired its key could be misreported as a straggler by a rank whose
+        # timer expired in the same instant the barrier completed. The keys
+        # are a few bytes per (barrier, rank) in the coordinator's RAM for
+        # the life of the job — a fair price for truthful diagnostics.
+        client.key_value_set(f"{barrier_id}/arrived/{rank()}", "1")
+        try:
+            client.wait_at_barrier(barrier_id, timeout_in_ms=int(timeout * 1000))
+        except Exception as e:
+            msg = str(e).lower()
+            if "deadline" in msg or "timeout" in msg or "timed out" in msg:
+                raise BarrierTimeout(tag, timeout, _find_stragglers(client, barrier_id)) from e
+            raise  # not a timeout (e.g. coordinator connection lost) — do not misdiagnose
     else:  # pragma: no cover - multiprocess without coordination service
         from jax.experimental import multihost_utils
 
@@ -414,6 +460,17 @@ def broadcast_object(obj: Any = None, root: int = 0, timeout: float = _DEFAULT_T
     return _get_obj(key, timeout)
 
 
+def _get_objs(name: str, seq: int, timeout: float) -> list[Any]:
+    """Fetch every rank's KV entry CONCURRENTLY — ``blocking_key_value_get``
+    releases the GIL during its gRPC wait, so a thread pool turns O(world)
+    serial round trips into ~one."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    n = world_size()
+    with ThreadPoolExecutor(max_workers=min(n, 32)) as ex:
+        return list(ex.map(lambda src: _get_obj(_kv_key(name, seq, src), timeout), range(n)))
+
+
 def all_gather_object(obj: Any, timeout: float = _DEFAULT_TIMEOUT) -> list[Any]:
     """Gather one picklable object from every process, returned to all ranks
     ordered by rank (reference ``all_gather_object``, util/distributed.py:121-128)."""
@@ -422,7 +479,7 @@ def all_gather_object(obj: Any, timeout: float = _DEFAULT_TIMEOUT) -> list[Any]:
     _seq["obj"] += 1
     seq = _seq["obj"]
     _put_obj(_kv_key("agather", seq, rank()), obj)
-    return [_get_obj(_kv_key("agather", seq, src), timeout) for src in range(world_size())]
+    return _get_objs("agather", seq, timeout)
 
 
 def gather_object(obj: Any, root: int = 0, timeout: float = _DEFAULT_TIMEOUT) -> list[Any] | None:
@@ -436,4 +493,17 @@ def gather_object(obj: Any, root: int = 0, timeout: float = _DEFAULT_TIMEOUT) ->
     barrier("gather_object", timeout)
     if rank() != root:
         return None
-    return [_get_obj(_kv_key("gather", seq, src), timeout) for src in range(world_size())]
+    return _get_objs("gather", seq, timeout)
+
+
+def all_gather_array(x) -> np.ndarray:
+    """Gather one same-shape numeric array from every process as
+    ``[world, *x.shape]`` via ONE XLA collective over ICI/DCN — the fast path
+    for the fused epoch-end metric exchange (metrics.py), replacing the
+    per-object KV-store hops entirely. All processes must call this with the
+    same shape/dtype (SPMD); a mismatch fails loudly in the collective."""
+    if world_size() <= 1:
+        return np.asarray(x)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=False))

@@ -7,7 +7,69 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dmlcloud_tpu.metrics import MetricReducer, MetricTracker, Reduction, reduce_tensor
+from dmlcloud_tpu.metrics import (
+    MetricReducer,
+    MetricTracker,
+    Reduction,
+    _pack_scalar_metrics,
+    _unpack_scalar_metrics,
+    reduce_tensor,
+)
+
+
+class TestFusedScalarExchange:
+    """The packed single-collective epoch exchange: pack on N simulated ranks,
+    stack (what all_gather_array returns), unpack — must reproduce the
+    per-metric reductions and the ragged-tracking diagnostics."""
+
+    NAMES = ["acc", "count", "loss", "lr"]
+    REDUCTIONS = {
+        "acc": Reduction.MAX,
+        "count": Reduction.SUM,
+        "loss": Reduction.MEAN,
+        "lr": Reduction.MIN,
+    }
+
+    def _gather(self, per_rank_locals):
+        return np.stack([_pack_scalar_metrics(self.NAMES, loc) for loc in per_rank_locals])
+
+    def test_reductions_across_ranks(self):
+        locals_ = [
+            {"acc": (False, 0.5), "count": (False, 10), "loss": (False, 2.0), "lr": (False, 0.1)},
+            {"acc": (False, 0.7), "count": (False, 12), "loss": (False, 4.0), "lr": (False, 0.3)},
+        ]
+        out = _unpack_scalar_metrics(self.NAMES, self._gather(locals_), self.REDUCTIONS)
+        assert out["acc"] == pytest.approx(0.7)
+        assert out["count"] == pytest.approx(22)
+        assert out["loss"] == pytest.approx(3.0)
+        assert out["lr"] == pytest.approx(0.1)
+
+    def test_all_empty_gives_none(self):
+        locals_ = [{n: (True, None) for n in self.NAMES} for _ in range(3)]
+        out = _unpack_scalar_metrics(self.NAMES, self._gather(locals_), self.REDUCTIONS)
+        assert all(v is None for v in out.values())
+
+    def test_ragged_tracking_raises(self):
+        locals_ = [
+            {"acc": (False, 0.5), "count": (False, 1), "loss": (False, 2.0), "lr": (False, 0.1)},
+            {"acc": (True, None), "count": (False, 1), "loss": (False, 2.0), "lr": (False, 0.1)},
+        ]
+        with pytest.raises(ValueError, match="some workers tracked"):
+            _unpack_scalar_metrics(self.NAMES, self._gather(locals_), self.REDUCTIONS)
+
+    def test_diverged_name_sets_detected(self):
+        a = _pack_scalar_metrics(["loss", "x"], {"loss": (False, 1.0), "x": (False, 2.0)})
+        b = _pack_scalar_metrics(["loss", "y"], {"loss": (False, 1.0), "y": (False, 2.0)})
+        with pytest.raises(ValueError, match="disagree"):
+            _unpack_scalar_metrics(["loss", "x"], np.stack([a, b]), {"loss": Reduction.MEAN, "x": Reduction.MEAN})
+
+    def test_int_sum_exact(self):
+        """SUM counters transit as float32 — exact for realistic per-epoch
+        batch counts (< 2**24)."""
+        locals_ = [{"count": (False, 2**20 + i)} for i in range(4)]
+        gathered = np.stack([_pack_scalar_metrics(["count"], loc) for loc in locals_])
+        out = _unpack_scalar_metrics(["count"], gathered, {"count": Reduction.SUM})
+        assert int(out["count"]) == sum(2**20 + i for i in range(4))
 
 
 class TestReduceTensor:
