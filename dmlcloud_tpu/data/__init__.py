@@ -1,5 +1,6 @@
 from .datasets import (
     BatchDataset,
+    DataPipeline,
     DownstreamDataset,
     PrefetchDataset,
     ShardedSequenceDataset,
@@ -13,6 +14,7 @@ from .sharding import chunk_and_shard_indices, shard_indices, shard_sequence
 
 __all__ = [
     "BatchDataset",
+    "DataPipeline",
     "DownstreamDataset",
     "PrefetchDataset",
     "ShardedSequenceDataset",

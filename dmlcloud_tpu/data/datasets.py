@@ -1,20 +1,35 @@
-"""Sharded iterable datasets, prefetching, batching, and batch interleaving.
+"""Host-side data pipelines: sharding, batching, prefetch, interleave.
 
-Capability parity with /root/reference/dmlcloud/util/data.py:70-341, torch-free
-at the core (numpy buffers instead of pinned torch tensors) but compatible
-with ``torch.utils.data.DataLoader``: when torch is importable the dataset
-base class is ``torch.utils.data.IterableDataset`` and worker sub-sharding
-via ``get_worker_info`` works exactly like the reference (effective rank =
-``rank * num_workers + worker_id``, data.py:133-138).
+Covers the capabilities of /root/reference/dmlcloud/util/data.py:70-341, but
+the architecture is a composable pipeline (tf.data / grain idiom) instead of
+the reference's one-wrapper-class-per-transform stack:
 
-The xarray chunk reader is duck-typed (anything with ``.isel``/indexable dims
-works), so xarray stays an optional dependency.
+- ``DataPipeline`` is the core: an epoch-aware iterator factory plus a chain
+  of combinators (``shard -> batch -> map -> interleave -> prefetch ->
+  to_device``). Every stage receives the epoch at iteration time, so
+  ``set_epoch`` needs no per-wrapper forwarding protocol — one call on the
+  pipeline re-seeds every shuffling stage.
+- Batch interleaving is ONE pytree-generic implementation (arrays, dicts, or
+  any nesting) with the C++ kernel (native/interleave.cpp) engaged for every
+  contiguous leaf — the reference maintains two near-identical Python-loop
+  variants and pins torch buffers.
+- ``to_device(mesh)`` ends a pipeline on-device: batches leave as
+  mesh-sharded global jax.Arrays with transfers running ahead of consumption
+  (data/device.py) — the reference stops at host tensors and leaves the
+  device copy to DDP/user code.
+
+The reference's class names (``ShardedSequenceDataset``, ``ShardedXrDataset``,
+``PrefetchDataset``, ``BatchDataset``, ``DownstreamDataset``) remain as thin
+shims over the combinators, including torch ``DataLoader`` worker
+sub-sharding via ``get_worker_info`` (effective rank = ``rank * num_workers
++ worker_id``, matching reference data.py:133-138 exactly).
 """
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Iterable, Iterator, Sequence
+import queue as _queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
@@ -41,6 +56,343 @@ def _effective_rank_world(rank: int, world_size: int) -> tuple[int, int]:
     return rank * info.num_workers + info.id, world_size * info.num_workers
 
 
+# ---------------------------------------------------------------------------
+# pipeline core
+# ---------------------------------------------------------------------------
+
+class DataPipeline(_DatasetBase):
+    """An epoch-aware, composable host-data pipeline.
+
+    Built from a ``make_iter(epoch) -> iterator`` factory; every combinator
+    returns a NEW pipeline whose factory pulls from this one's, threading the
+    epoch through the whole chain. Iteration state never lives on the
+    pipeline object, so one pipeline can be iterated repeatedly (one pass per
+    epoch — the TrainValStage contract).
+    """
+
+    def __init__(self, make_iter: Callable[[int | None], Iterator], length_fn: Callable[[], int] | None = None):
+        self._make_iter = make_iter
+        self._length_fn = length_fn
+        #: None until set_epoch is called — sources distinguish "caller never
+        #: drives epochs through this pipeline" (leave wrapped datasets'
+        #: own epoch state alone) from an explicit epoch 0.
+        self.epoch: int | None = None
+
+    # -- protocol -----------------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        """Re-seed every shuffling stage for this epoch (the reference's
+        DistributedSampler.set_epoch analog)."""
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator:
+        return self._make_iter(self.epoch)
+
+    def __len__(self) -> int:
+        if self._length_fn is None:
+            raise TypeError(f"{type(self).__name__} has no length")
+        return self._length_fn()
+
+    # -- sources ------------------------------------------------------------
+    @classmethod
+    def from_source(cls, iterable: Iterable) -> "DataPipeline":
+        """Wrap any (re-)iterable; its ``set_epoch`` is honored if present."""
+
+        def make(epoch: int | None) -> Iterator:
+            # forward only an EXPLICIT epoch — a pipeline nobody drives must
+            # not stomp an epoch the user set directly on the inner dataset
+            if epoch is not None and hasattr(iterable, "set_epoch"):
+                iterable.set_epoch(epoch)
+            return iter(iterable)
+
+        try:
+            n = len(iterable)  # type: ignore[arg-type]
+            length = lambda: n  # noqa: E731
+        except TypeError:
+            length = None
+        return cls(make, length)
+
+    @classmethod
+    def from_sequence(
+        cls,
+        sequence: Sequence,
+        shuffle: bool = False,
+        even_shards: bool = True,
+        seed: int = 0,
+        rank: int | None = None,
+        world_size: int | None = None,
+    ) -> "DataPipeline":
+        """This process's share of ``sequence``, reshuffled per epoch; the
+        shard is computed lazily at iteration time so torch DataLoader
+        workers sub-shard correctly."""
+        rank = runtime.rank() if rank is None else rank
+        world_size = runtime.world_size() if world_size is None else world_size
+
+        def make(epoch: int | None) -> Iterator:
+            r, w = _effective_rank_world(rank, world_size)
+            e = 0 if epoch is None else epoch
+            return iter(
+                shard_sequence(sequence, r, w, shuffle=shuffle, even_shards=even_shards, seed=seed + e)
+            )
+
+        def length() -> int:
+            if even_shards:
+                return len(sequence) // world_size
+            n, rem = divmod(len(sequence), world_size)
+            return n + (1 if rank < rem else 0)
+
+        return cls(make, length)
+
+    @classmethod
+    def from_chunked(
+        cls,
+        ds: Any,
+        dim: str,
+        chunk_size: int,
+        chunk_overlap: int = 0,
+        even_shards: bool = True,
+        equal_chunks: bool = True,
+        shuffle: bool = False,
+        seed: int = 0,
+        rank: int | None = None,
+        world_size: int | None = None,
+        load: bool = False,
+        load_kwargs: dict | None = None,
+    ) -> "DataPipeline":
+        """This process's chunks of an xarray-like (``.isel``-capable) dataset
+        along ``dim`` — overlapping windows supported for time-series context
+        (capability of reference data.py:70-107)."""
+        rank = runtime.rank() if rank is None else rank
+        world_size = runtime.world_size() if world_size is None else world_size
+
+        def make(epoch: int | None) -> Iterator:
+            r, w = _effective_rank_world(rank, world_size)
+            e = 0 if epoch is None else epoch
+            return _iter_chunks(
+                ds, dim, chunk_size, chunk_overlap, even_shards, equal_chunks,
+                shuffle, seed + e, r, w, load, load_kwargs,
+            )
+
+        return cls(make)
+
+    # -- combinators --------------------------------------------------------
+    def _chain(self, wrap: Callable[[Iterator, int], Iterator], length_fn=None) -> "DataPipeline":
+        parent_make = self._make_iter
+        return DataPipeline(lambda epoch: wrap(parent_make(epoch), epoch), length_fn)
+
+    def map(self, fn: Callable[[Any], Any]) -> "DataPipeline":
+        return self._chain(lambda it, _e: (fn(x) for x in it), self._length_fn)
+
+    def batch(self, batch_size: int, drop_remainder: bool = False, collate: Callable | None = None) -> "DataPipeline":
+        """Group consecutive elements into lists of ``batch_size`` (optionally
+        collated, e.g. ``np.stack``)."""
+
+        def wrap(it: Iterator, _e: int) -> Iterator:
+            buf: list = []
+            for x in it:
+                buf.append(x)
+                if len(buf) == batch_size:
+                    yield collate(buf) if collate else buf
+                    buf = []
+            if buf and not drop_remainder:
+                yield collate(buf) if collate else buf
+
+        parent_len = self._length_fn
+
+        def length() -> int:
+            if parent_len is None:
+                raise TypeError("unsized pipeline")
+            n = parent_len()
+            return n // batch_size if drop_remainder else -(-n // batch_size)
+
+        return self._chain(wrap, length if parent_len is not None else None)
+
+    def interleave(self, num_batches: int, copy: bool = True) -> "DataPipeline":
+        """Re-mix groups of ``num_batches`` consecutive batches (see
+        ``interleave_batches``). Batches are COPIED out of the interleave
+        buffer by default, because downstream lookahead stages (``prefetch``,
+        ``to_device``) hold several batches concurrently and would otherwise
+        observe the buffer being rewritten by the next window. Pass
+        ``copy=False`` only for a pipeline consumed strictly one batch at a
+        time."""
+        return self._chain(lambda it, _e: _interleave_pytrees(it, num_batches, copy=copy), self._length_fn)
+
+    def prefetch(self, num_elements: int) -> "DataPipeline":
+        """Read ahead ``num_elements`` items on a background thread, keeping
+        host IO off the training thread's critical path."""
+        return self._chain(lambda it, _e: _prefetch_iter(it, num_elements), self._length_fn)
+
+    def to_device(self, mesh, pspec=None, prefetch: int = 2) -> "DataPipeline":
+        """End the pipeline on-device: batches become mesh-sharded global
+        jax.Arrays with ``prefetch`` transfers in flight ahead of the step."""
+        from .device import device_iterator
+
+        return self._chain(
+            lambda it, _e: device_iterator(it, mesh, pspec=pspec, prefetch=prefetch), self._length_fn
+        )
+
+
+def _iter_chunks(
+    ds, dim, chunk_size, chunk_overlap, even_shards, equal_chunks, shuffle, seed, rank, world_size, load, load_kwargs
+) -> Iterator[Any]:
+    num_elements = len(ds[dim]) if hasattr(ds, "__getitem__") and not isinstance(ds, np.ndarray) else ds.sizes[dim]
+    chunks = chunk_and_shard_indices(
+        num_elements, chunk_size, rank, world_size,
+        chunk_overlap=chunk_overlap, even_shards=even_shards, equal_chunks=equal_chunks,
+        shuffle=shuffle, seed=seed,
+    )
+    for start, end in chunks:
+        chunk = ds.isel({dim: slice(start, end)})
+        if load:
+            chunk.load(**(load_kwargs or {}))
+        yield chunk
+
+
+def _prefetch_iter(src: Iterator, num_elements: int) -> Iterator:
+    """Bounded-queue background reader. Exceptions in the source re-raise in
+    the consumer; closing/abandoning the consumer generator signals the
+    producer to stop (otherwise it would block forever on a full queue,
+    pinning the thread, its queued batches, and the source iterator)."""
+    q: _queue.Queue = _queue.Queue(maxsize=max(num_elements, 1))
+    stop = threading.Event()
+    _END, _ERR = object(), object()
+
+    def put(item: Any) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for item in src:
+                if not put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+            put((_ERR, e))
+            return
+        put(_END)
+
+    thread = threading.Thread(target=produce, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        try:  # free one slot so a put-blocked producer observes stop promptly
+            q.get_nowait()
+        except _queue.Empty:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# batch interleaving (pytree-generic, native-accelerated)
+# ---------------------------------------------------------------------------
+
+def _interleave_pytrees(iterable: Iterable[Any], num_batches: int, copy: bool = False) -> Iterator[Any]:
+    """Re-slice each window of ``num_batches`` consecutive batches into
+    ``num_batches`` mixed batches, per pytree leaf, through preallocated
+    buffers. Mixed batch ``i`` is the concatenation of slice ``i`` of every
+    window batch — restores within-batch diversity when upstream chunked
+    reads (e.g. xarray time chunks) make batches internally correlated.
+
+    Leaves are interleaved by the C++ kernel (native/interleave.cpp) when
+    contiguous, else by strided numpy copies. Yielded leaves ALIAS the reused
+    buffers: consume or copy before advancing.
+    """
+    import jax
+
+    if num_batches < 1:
+        raise ValueError("num_batches must be greater than 0")
+    if num_batches == 1:
+        yield from iterable
+        return
+
+    try:
+        from ..native import interleave as _native
+
+        native_ok = _native.available()
+    except Exception:  # pragma: no cover
+        _native, native_ok = None, False
+
+    treedef = None
+    buffers: list[np.ndarray] = []
+    slice_sizes: list[int] = []
+    window: list[list[np.ndarray]] = []
+
+    for batch in iterable:
+        leaves, this_def = jax.tree_util.tree_flatten(batch)
+        leaves = [np.asarray(x) for x in leaves]
+        if treedef is None:
+            treedef = this_def
+            for leaf in leaves:
+                if leaf.shape[0] % num_batches:
+                    raise ValueError(
+                        f"Batch dimension ({leaf.shape[0]}) must be divisible by num_batches={num_batches}"
+                    )
+                slice_sizes.append(leaf.shape[0] // num_batches)
+                buffers.append(np.empty((num_batches, *leaf.shape), dtype=leaf.dtype))
+
+        window.append(leaves)
+        if len(window) < num_batches:
+            continue
+
+        for li, (buf, s) in enumerate(zip(buffers, slice_sizes)):
+            srcs = [w[li] for w in window]
+            if native_ok and all(b.flags.c_contiguous for b in srcs):
+                _native.interleave_into(buf, srcs, s)
+            else:
+                for i in range(num_batches):
+                    for j in range(num_batches):
+                        buf[i, j * s : (j + 1) * s] = srcs[j][i * s : (i + 1) * s]
+        window = []
+        for i in range(num_batches):
+            leaves_out = [buf[i].copy() if copy else buf[i] for buf in buffers]
+            yield jax.tree_util.tree_unflatten(treedef, leaves_out)
+
+
+def interleave_batches(iterable: Iterable[np.ndarray], num_batches: int) -> Iterator[np.ndarray]:
+    """Array variant (capability of reference data.py:266-301). Yielded views
+    alias a reused buffer — consume or copy immediately."""
+    return _interleave_pytrees(iterable, num_batches)
+
+
+def interleave_dict_batches(
+    iterable: Iterable[dict[str, np.ndarray]], num_batches: int
+) -> Iterator[dict[str, np.ndarray]]:
+    """Dict-of-arrays variant (capability of reference data.py:304-341) —
+    same pytree core, same C++ fast path. Yielded dicts alias reused buffers."""
+    return _interleave_pytrees(iterable, num_batches)
+
+
+# ---------------------------------------------------------------------------
+# reference-parity shims (class API of dmlcloud.util.data)
+# ---------------------------------------------------------------------------
+
+class _ReconstructOnUnpickle:
+    """The pipeline core holds closures, which do not pickle; the shims must
+    pickle because torch DataLoader workers receive the dataset by pickle.
+    Each shim records its constructor arguments and is rebuilt (epoch
+    preserved) on the other side."""
+
+    _ctor_args: tuple = ()
+    _ctor_kwargs: dict = {}
+
+    def __getstate__(self):
+        return {"args": self._ctor_args, "kwargs": self._ctor_kwargs, "epoch": self.epoch}
+
+    def __setstate__(self, state):
+        self.__init__(*state["args"], **state["kwargs"])
+        self.epoch = state["epoch"]
+
 def sharded_xr_dataset(
     ds: Any,
     dim: str,
@@ -55,36 +407,19 @@ def sharded_xr_dataset(
     load: bool = False,
     load_kwargs: dict | None = None,
 ) -> Iterator[Any]:
-    """Lazily slice an xarray Dataset/DataArray (or any ``.isel``-capable
-    object) along ``dim`` into per-rank chunks (reference data.py:70-107).
-    ``chunk_overlap`` yields overlapping windows for time-series context."""
-    if rank is None:
-        rank = runtime.rank()
-    if world_size is None:
-        world_size = runtime.world_size()
-
-    num_elements = len(ds[dim]) if hasattr(ds, "__getitem__") and not isinstance(ds, np.ndarray) else ds.sizes[dim]
-    chunks = chunk_and_shard_indices(
-        num_elements,
-        chunk_size,
-        rank,
-        world_size,
-        chunk_overlap=chunk_overlap,
-        even_shards=even_shards,
-        equal_chunks=equal_chunks,
-        shuffle=shuffle,
-        seed=seed,
+    """One epoch of per-rank chunks of an ``.isel``-capable dataset
+    (reference data.py:70-107)."""
+    rank = runtime.rank() if rank is None else rank
+    world_size = runtime.world_size() if world_size is None else world_size
+    return _iter_chunks(
+        ds, dim, chunk_size, chunk_overlap, even_shards, equal_chunks,
+        shuffle, seed, rank, world_size, load, load_kwargs,
     )
-    for start, end in chunks:
-        chunk = ds.isel({dim: slice(start, end)})
-        if load:
-            chunk.load(**(load_kwargs or {}))
-        yield chunk
 
 
-class ShardedSequenceDataset(_DatasetBase):
-    """Iterable over this rank's share of a sequence, reshuffled per epoch via
-    ``set_epoch`` (reference data.py:110-147)."""
+class ShardedSequenceDataset(_ReconstructOnUnpickle, DataPipeline):
+    """Reference-parity shim over ``DataPipeline.from_sequence``
+    (reference data.py:110-147)."""
 
     def __init__(
         self,
@@ -95,247 +430,67 @@ class ShardedSequenceDataset(_DatasetBase):
         rank: int | None = None,
         world_size: int | None = None,
     ):
-        self.sequence = sequence
-        self.shuffle = shuffle
-        self.even_shards = even_shards
-        self.seed = seed
-        self.rank = rank if rank is not None else runtime.rank()
-        self.world_size = world_size if world_size is not None else runtime.world_size()
-        self.epoch = 0
-
-    def set_epoch(self, epoch: int) -> None:
-        self.epoch = epoch
-
-    def __len__(self) -> int:
-        if self.even_shards:
-            return len(self.sequence) // self.world_size
-        n, r = divmod(len(self.sequence), self.world_size)
-        return n + (1 if self.rank < r else 0)
-
-    def __iter__(self):
-        rank, world_size = _effective_rank_world(self.rank, self.world_size)
-        shards = shard_sequence(
-            self.sequence,
-            rank,
-            world_size,
-            shuffle=self.shuffle,
-            even_shards=self.even_shards,
-            seed=self.seed + self.epoch,
+        rank = runtime.rank() if rank is None else rank
+        world_size = runtime.world_size() if world_size is None else world_size
+        self._ctor_args = (sequence, shuffle, even_shards, seed, rank, world_size)
+        self._ctor_kwargs = {}
+        p = DataPipeline.from_sequence(
+            sequence, shuffle=shuffle, even_shards=even_shards, seed=seed, rank=rank, world_size=world_size
         )
-        return iter(shards)
+        super().__init__(p._make_iter, p._length_fn)
+        self.sequence = sequence
 
 
-class ShardedXrDataset(_DatasetBase):
-    """Iterable over this rank's chunks of an xarray-like dataset
+class ShardedXrDataset(_ReconstructOnUnpickle, DataPipeline):
+    """Reference-parity shim over ``DataPipeline.from_chunked``
     (reference data.py:150-207)."""
 
-    def __init__(
-        self,
-        ds: Any,
-        dim: str,
-        chunk_size: int,
-        chunk_overlap: int = 0,
-        even_shards: bool = True,
-        equal_chunks: bool = True,
-        shuffle: bool = False,
-        seed: int = 0,
-        rank: int | None = None,
-        world_size: int | None = None,
-        load: bool = False,
-        load_kwargs: dict | None = None,
-    ):
+    def __init__(self, ds: Any, dim: str, chunk_size: int, **kwargs):
+        kwargs.setdefault("rank", runtime.rank())
+        kwargs.setdefault("world_size", runtime.world_size())
+        self._ctor_args = (ds, dim, chunk_size)
+        self._ctor_kwargs = dict(kwargs)
+        p = DataPipeline.from_chunked(ds, dim, chunk_size, **kwargs)
+        super().__init__(p._make_iter, p._length_fn)
         self.ds = ds
-        self.dim = dim
-        self.chunk_size = chunk_size
-        self.chunk_overlap = chunk_overlap
-        self.even_shards = even_shards
-        self.equal_chunks = equal_chunks
-        self.shuffle = shuffle
-        self.seed = seed
-        self.load = load
-        self.load_kwargs = load_kwargs
-        self.rank = rank if rank is not None else runtime.rank()
-        self.world_size = world_size if world_size is not None else runtime.world_size()
-        self._num_iters = 0
-
-    def set_epoch(self, epoch: int) -> None:
-        self._num_iters = epoch
-
-    def __iter__(self):
-        rank, world_size = _effective_rank_world(self.rank, self.world_size)
-        return sharded_xr_dataset(
-            self.ds,
-            self.dim,
-            self.chunk_size,
-            chunk_overlap=self.chunk_overlap,
-            even_shards=self.even_shards,
-            equal_chunks=self.equal_chunks,
-            shuffle=self.shuffle,
-            seed=self.seed + self._num_iters,
-            rank=rank,
-            world_size=world_size,
-            load=self.load,
-            load_kwargs=self.load_kwargs,
-        )
 
 
-class DownstreamDataset(_DatasetBase):
-    """Base for dataset wrappers: forwards ``set_epoch`` and ``__len__``
-    (reference data.py:210-219)."""
+class DownstreamDataset(_ReconstructOnUnpickle, DataPipeline):
+    """Reference-parity base for wrappers (reference data.py:210-219):
+    epoch setting propagates to the wrapped source."""
 
     def __init__(self, source_ds: Iterable):
+        self._ctor_args = (source_ds,)
+        self._ctor_kwargs = {}
+        p = DataPipeline.from_source(source_ds)
+        super().__init__(p._make_iter, p._length_fn)
         self.source_ds = source_ds
 
     def set_epoch(self, epoch: int) -> None:
+        super().set_epoch(epoch)
         if hasattr(self.source_ds, "set_epoch"):
             self.source_ds.set_epoch(epoch)
 
-    def __len__(self) -> int:
-        return len(self.source_ds)
-
 
 class PrefetchDataset(DownstreamDataset):
-    """Background-thread lookahead of ``num_elements`` items (reference
-    data.py:222-240) — keeps host-side IO off the training thread's critical
-    path so the TPU dispatch queue stays full."""
+    """Reference-parity shim over ``.prefetch()`` (reference data.py:222-240)."""
 
     def __init__(self, source_ds: Iterable, num_elements: int):
         super().__init__(source_ds)
+        self._ctor_args = (source_ds, num_elements)
         self.num_elements = num_elements
-
-    def __iter__(self):
-        pool = ThreadPoolExecutor(max_workers=1)
-        iter_ = iter(self.source_ds)
-        with pool:
-            futures = [pool.submit(next, iter_) for _ in range(self.num_elements)]
-            while True:
-                future = futures.pop(0)
-                try:
-                    element = future.result()
-                except StopIteration:
-                    return
-                futures.append(pool.submit(next, iter_))
-                yield element
+        parent = self._make_iter
+        self._make_iter = lambda epoch: _prefetch_iter(parent(epoch), num_elements)
 
 
 class BatchDataset(DownstreamDataset):
-    """Group consecutive elements into lists of ``batch_size`` (reference
-    data.py:243-263)."""
+    """Reference-parity shim over ``.batch()`` (reference data.py:243-263)."""
 
     def __init__(self, source_ds: Iterable, batch_size: int, drop_remainder: bool = False):
         super().__init__(source_ds)
+        self._ctor_args = (source_ds, batch_size, drop_remainder)
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
-
-    def __len__(self) -> int:
-        n = len(self.source_ds)
-        if self.drop_remainder:
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
-
-    def __iter__(self):
-        batch = []
-        for element in self.source_ds:
-            batch.append(element)
-            if len(batch) == self.batch_size:
-                yield batch
-                batch = []
-        if batch and not self.drop_remainder:
-            yield batch
-
-
-def interleave_batches(
-    iterable: Iterable[np.ndarray], num_batches: int
-) -> Iterator[np.ndarray]:
-    """Re-slice ``num_batches`` consecutive batches into ``num_batches`` mixed
-    batches through one preallocated buffer (reference data.py:266-301).
-    Yielded views alias the buffer — consume or copy immediately.
-
-    Useful when chunked sequential reads (e.g. xarray time chunks) would give
-    each batch correlated content: interleaving restores within-batch mixing
-    at memcpy cost, no extra allocation per batch. See also
-    ``dmlcloud_tpu.native.fast_interleave`` for the C++ path used
-    automatically when the extension is built.
-    """
-    if num_batches < 1:
-        raise ValueError("num_batches must be greater than 0")
-    if num_batches == 1:
-        yield from iterable
-        return
-
-    try:
-        from ..native import interleave as _native
-    except Exception:
-        _native = None
-
-    batches: list[np.ndarray] = []
-    memory = None
-    slice_size = None
-    for batch in iterable:
-        batch = np.asarray(batch)
-        if memory is None:
-            batch_size = batch.shape[0]
-            slice_size = batch_size // num_batches
-            if batch_size % num_batches != 0:
-                raise ValueError(
-                    f"Batch dimension ({batch_size}) must be divisible by num_batches={num_batches}"
-                )
-            memory = np.empty((num_batches, *batch.shape), dtype=batch.dtype)
-
-        batches.append(batch)
-
-        if len(batches) == num_batches:
-            if (
-                _native is not None
-                and _native.available()
-                and all(b.flags.c_contiguous for b in batches)
-            ):
-                _native.interleave_into(memory, batches, slice_size)
-            else:
-                for i in range(num_batches):
-                    for j in range(num_batches):
-                        memory[i, j * slice_size : (j + 1) * slice_size] = batches[j][
-                            i * slice_size : (i + 1) * slice_size
-                        ]
-            batches = []
-            for i in range(num_batches):
-                yield memory[i]
-
-
-def interleave_dict_batches(
-    iterable: Iterable[dict[str, np.ndarray]], num_batches: int
-) -> Iterator[dict[str, np.ndarray]]:
-    """Dict-of-arrays variant of ``interleave_batches`` (reference
-    data.py:304-341). Yielded dicts alias the buffers — consume immediately."""
-    if num_batches < 1:
-        raise ValueError("num_batches must be greater than 0")
-    if num_batches == 1:
-        yield from iterable
-        return
-
-    batches: list[dict[str, np.ndarray]] = []
-    memory: dict[str, np.ndarray] = {}
-    slice_size: dict[str, int] = {}
-    for batch in iterable:
-        batch = {k: np.asarray(v) for k, v in batch.items()}
-        if not memory:
-            for k, arr in batch.items():
-                batch_size = arr.shape[0]
-                if batch_size % num_batches != 0:
-                    raise ValueError(
-                        f"Batch dimension ({batch_size}) must be divisible by num_batches={num_batches}"
-                    )
-                slice_size[k] = batch_size // num_batches
-                memory[k] = np.empty((num_batches, *arr.shape), dtype=arr.dtype)
-
-        batches.append(batch)
-
-        if len(batches) == num_batches:
-            for k in memory:
-                s = slice_size[k]
-                for i in range(num_batches):
-                    for j in range(num_batches):
-                        memory[k][i, j * s : (j + 1) * s] = batches[j][k][i * s : (i + 1) * s]
-            batches = []
-            for i in range(num_batches):
-                yield {k: memory[k][i] for k in memory}
+        batched = DataPipeline(self._make_iter, self._length_fn).batch(batch_size, drop_remainder)
+        self._make_iter = batched._make_iter
+        self._length_fn = batched._length_fn

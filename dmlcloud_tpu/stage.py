@@ -285,6 +285,14 @@ class TrainValStage(Stage):
         """Which registered model this stage trains (None = the only one)."""
         return None
 
+    def device_prefetch(self) -> int:
+        """Batches kept in flight on device ahead of the compiled step (the
+        default feeding path runs every dataset through
+        ``data.device_iterator``, overlapping host->HBM transfers with
+        compute). Return 0 to feed synchronously (one ``make_global_batch``
+        per step) — e.g. when batches are huge and HBM is tight."""
+        return 2
+
     def checkpoint_every(self) -> int:
         """Epochs between automatic TrainState saves (0 disables). Active
         only when ``pipeline.enable_checkpointing()`` was called. The
@@ -552,6 +560,17 @@ class TrainValStage(Stage):
         anything already device-resident."""
         return mesh_lib.make_global_batch(batch, self.mesh)
 
+    def _feed(self, ds):
+        """The device feeding path: mesh-sharded batches with
+        ``device_prefetch()`` transfers in flight ahead of the step
+        (data/device.py), or per-step synchronous puts when disabled."""
+        prefetch = int(self.device_prefetch())
+        if prefetch > 0:
+            from .data.device import device_iterator
+
+            return device_iterator(ds, self.mesh, prefetch=prefetch)
+        return (self._put(batch) for batch in ds)
+
     def train_epoch(self):
         self.is_train = True
         self.metric_prefix = self.train_metric_prefix()
@@ -563,9 +582,8 @@ class TrainValStage(Stage):
             train_ds.sampler.set_epoch(self.current_epoch)
 
         last_metrics = None
-        for batch in train_ds:
+        for batch in self._feed(train_ds):
             step_start = time.perf_counter_ns()
-            batch = self._put(batch)
             self.state, metrics = self._train_step_fn(self.state, batch)
             step_end = time.perf_counter_ns()
 
@@ -597,8 +615,7 @@ class TrainValStage(Stage):
             return  # val dataset optional in the TPU build
 
         last_metrics = None
-        for batch in val_ds:
-            batch = self._put(batch)
+        for batch in self._feed(val_ds):
             metrics = self._val_step_fn(self.state, batch)
             for mname, mval in metrics.items():
                 self.track_reduce(mname, mval)
