@@ -23,8 +23,39 @@ from datetime import datetime
 from pathlib import Path
 from typing import Any
 
+from etils import epath
+
 from .utils import slurm
 from .utils.config import Config, as_config
+
+
+def as_run_path(path: Any) -> epath.Path:
+    """Normalise to an ``etils.epath.Path``. URI paths (``gs://``, ``s3://``,
+    ...) pass through untouched — ``Path.resolve()`` would mangle the scheme
+    into ``gs:/bucket`` before any backend saw it; local paths are expanded
+    and absolutised for stable equality across processes."""
+    if isinstance(path, epath.Path):
+        return path
+    s = os.fspath(path)
+    if "://" in s:
+        return epath.Path(s)
+    return epath.Path(os.path.abspath(os.path.expanduser(s)))
+
+
+def is_remote_path(path: Any) -> bool:
+    return "://" in os.fspath(path)
+
+
+def atomic_write_text(target: epath.Path, text: str) -> None:
+    """Crash-safe small-file write. Local filesystems get tmp-file +
+    ``os.replace``; object stores commit whole objects atomically already,
+    so a direct write is equivalent there (and rename is not atomic on GCS)."""
+    if is_remote_path(target):
+        target.write_text(text)
+        return
+    tmp = target.parent / f".{target.name}.tmp"
+    tmp.write_text(text)
+    os.replace(os.fspath(tmp), os.fspath(target))
 
 #: Indicator file marking a valid run directory (reference: ``.dmlcloud``,
 #: checkpoint.py:58-60).
@@ -42,11 +73,11 @@ def generate_id(length: int = 8) -> str:
 
 
 def generate_checkpoint_path(
-    root: str | Path, name: str | None = None, dt: datetime | None = None
-) -> Path:
+    root: str | Path | epath.Path, name: str | None = None, dt: datetime | None = None
+) -> epath.Path:
     """``{root}/{name}-{YYYY.MM.DD-HH.MM}-{id}`` — collision-free, sortable
-    (reference checkpoint.py:21-34)."""
-    root = Path(root)
+    (reference checkpoint.py:21-34). ``root`` may be a ``gs://`` URI."""
+    root = as_run_path(root)
     if name is None:
         name = "run"
     if dt is None:
@@ -55,14 +86,14 @@ def generate_checkpoint_path(
     return root / sanitize_filename(f"{name}-{stamp}-{generate_id()}")
 
 
-def find_slurm_checkpoint(root: str | Path) -> Path | None:
+def find_slurm_checkpoint(root: str | Path | epath.Path) -> epath.Path | None:
     """Scan ``root`` for a run dir whose recorded Slurm job id matches the
     current job — how a requeued job finds its own previous checkpoint
     (reference checkpoint.py:37-48)."""
     job_id = slurm.slurm_job_id()
     if job_id is None:
         return None
-    root = Path(root)
+    root = as_run_path(root)
     if not root.exists():
         return None
     for child in root.iterdir():
@@ -85,30 +116,30 @@ class CheckpointDir:
           state/            # Orbax CheckpointManager root (sharded tensors)
     """
 
-    def __init__(self, path: str | Path):
-        self.path = Path(path).resolve()
+    def __init__(self, path: str | Path | epath.Path):
+        self.path = as_run_path(path)
         self._state_managers: dict[str | None, Any] = {}
         self._manager_opts: dict[str | None, tuple] = {}
 
     # -- contract files -----------------------------------------------------
     @property
-    def config_file(self) -> Path:
+    def config_file(self) -> epath.Path:
         return self.path / "config.yaml"
 
     @property
-    def indicator_file(self) -> Path:
+    def indicator_file(self) -> epath.Path:
         return self.path / INDICATOR_FILE
 
     @property
-    def log_file(self) -> Path:
+    def log_file(self) -> epath.Path:
         return self.path / "log.txt"
 
     @property
-    def slurm_file(self) -> Path:
+    def slurm_file(self) -> epath.Path:
         return self.path / ".slurm-jobid"
 
     @property
-    def state_dir(self) -> Path:
+    def state_dir(self) -> epath.Path:
         return self.path / "state"
 
     # -- validity (reference checkpoint.py:76-92) ---------------------------

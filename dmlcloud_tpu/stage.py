@@ -446,8 +446,8 @@ class TrainValStage(Stage):
         ckpt.save_state(completed, self._state_pytree(), scope=self.name)
         if is_root():
             import json
-            import os
 
+            from .checkpoint import atomic_write_text
             from .utils.serialization import to_jsonable
 
             meta_dir = ckpt.path / "meta" / self.name
@@ -470,9 +470,7 @@ class TrainValStage(Stage):
             }
             # atomic write: a preemption mid-write must not leave a truncated
             # sidecar that breaks the very resume it exists for
-            tmp = meta_dir / f".{completed}.json.tmp"
-            tmp.write_text(json.dumps(meta))
-            os.replace(tmp, meta_dir / f"{completed}.json")
+            atomic_write_text(meta_dir / f"{completed}.json", json.dumps(meta))
             # keep sidecars in lockstep with Orbax retention (max_to_keep);
             # *.pkl covers sidecars from the pre-JSON format
             kept = set(ckpt.state_manager(self.name).all_steps()) | {completed}
@@ -581,6 +579,18 @@ class TrainValStage(Stage):
         elif hasattr(train_ds, "sampler") and hasattr(getattr(train_ds, "sampler"), "set_epoch"):
             train_ds.sampler.set_epoch(self.current_epoch)
 
+        # Live console row (reference stage.py:188-205 UX): loss EMA and
+        # steps/s update in place during the epoch. The EMA fetch trails the
+        # dispatch by 2 steps so it reads an already-computed value instead
+        # of stalling the async pipeline; everything is skipped entirely
+        # when no live console exists (non-root, log files, CI, benches).
+        live = self.table.live_target() is not None
+        pending_losses: list = []
+        loss_ema = None
+        steps_done = 0
+        epoch_t0 = time.perf_counter()
+        last_render = 0.0
+
         last_metrics = None
         for batch in self._feed(train_ds):
             step_start = time.perf_counter_ns()
@@ -595,6 +605,27 @@ class TrainValStage(Stage):
             )
             self.track_reduce("misc/step_time_ms", (step_end - step_start) / 1e6, prefixed=False)
             last_metrics = metrics
+
+            steps_done += 1
+            if live:
+                pending_losses.append(metrics.get(self.loss_metric_name()))
+                if len(pending_losses) > 2:
+                    val = pending_losses.pop(0)
+                    if val is not None:
+                        val = float(jax.device_get(val))
+                        loss_ema = val if loss_ema is None else 0.98 * loss_ema + 0.02 * val
+                now = time.perf_counter()
+                if now - last_render > 0.25:
+                    self.table.live(
+                        {
+                            "Epoch": self.current_epoch,
+                            "[Train] Loss": loss_ema,
+                            "it/s": steps_done / max(now - epoch_t0, 1e-9),
+                        }
+                    )
+                    last_render = now
+
+        self.table["it/s"] = steps_done / max(time.perf_counter() - epoch_t0, 1e-9)
 
         # Close the async dispatch pipeline so epoch timing/metrics are honest:
         # ONE device sync per epoch instead of one per step.
@@ -631,4 +662,5 @@ class TrainValStage(Stage):
         columns = super().table_columns()
         columns.insert(1, {"name": "[Train] Loss", "metric": f"{self.train_metric_prefix()}/{self.loss_metric_name()}"})
         columns.insert(2, {"name": "[Val] Loss", "metric": f"{self.val_metric_prefix()}/{self.loss_metric_name()}"})
+        columns.insert(3, {"name": "it/s", "metric": None})  # live + epoch average
         return columns

@@ -80,15 +80,23 @@ class Config(Mapping):
         return yaml.safe_dump(self.to_dict(), sort_keys=False)
 
     def save(self, path: str | Path) -> None:
-        Path(path).write_text(self.to_yaml())
+        _as_epath(path).write_text(self.to_yaml())
 
     @classmethod
     def load(cls, path: str | Path) -> "Config":
-        data = yaml.safe_load(Path(path).read_text())
+        data = yaml.safe_load(_as_epath(path).read_text())
         return cls(data or {})
 
     def __repr__(self) -> str:
         return f"Config({self.to_dict()!r})"
+
+
+def _as_epath(path):
+    """URI-capable path coercion (``pathlib.Path("gs://b")`` would collapse
+    the double slash); local strings behave exactly as before."""
+    from etils import epath
+
+    return path if isinstance(path, epath.Path) else epath.Path(str(path))
 
 
 def as_config(obj: Any) -> Config:

@@ -34,6 +34,46 @@ BANNER = r"""
 """
 
 
+class _RemoteLogFile:
+    """log.txt tee for object stores (no append support): buffers writes,
+    prepends any PREVIOUS attempt's log (a requeued run must not destroy the
+    crashed attempt's history, which open('w') would), and re-uploads the
+    whole object at most every ``flush_interval`` seconds and at close."""
+
+    def __init__(self, path_str: str, flush_interval: float = 30.0):
+        import time
+
+        from etils import epath
+
+        self._path = epath.Path(path_str)
+        self._flush_interval = flush_interval
+        self._time = time
+        self._last_upload = 0.0
+        try:
+            self._parts: list[str] = [self._path.read_text()] if self._path.exists() else []
+        except Exception:
+            self._parts = []
+
+    def write(self, s: str) -> int:
+        self._parts.append(s)
+        return len(s)
+
+    def flush(self) -> None:
+        now = self._time.monotonic()
+        if now - self._last_upload >= self._flush_interval:
+            self._upload()
+            self._last_upload = now
+
+    def _upload(self) -> None:
+        try:
+            self._path.write_text("".join(self._parts))
+        except Exception:  # pragma: no cover - log upload must never kill the run
+            pass
+
+    def close(self) -> None:
+        self._upload()
+
+
 class IORedirector:
     """Tee ``sys.stdout``/``sys.stderr`` into a log file while still writing to
     the original streams (reference util/logging.py:18-81). Installed root-only
@@ -72,7 +112,7 @@ class IORedirector:
             return self.stream.fileno()
 
     def __init__(self, log_file: str | Path):
-        self.log_path = Path(log_file)
+        self.log_path = log_file
         self.file = None
         self._orig_stdout = None
         self._orig_stderr = None
@@ -80,7 +120,11 @@ class IORedirector:
     def install(self) -> None:
         if self.file is not None:
             return
-        self.file = open(self.log_path, "a", buffering=1)
+        path_str = os.fspath(self.log_path)
+        if "://" in path_str:
+            self.file = _RemoteLogFile(path_str)
+        else:
+            self.file = open(path_str, "a", buffering=1)
         self._orig_stdout = sys.stdout
         self._orig_stderr = sys.stderr
         sys.stdout = IORedirector._Tee(self, self._orig_stdout)

@@ -70,3 +70,81 @@ def test_orbax_state_roundtrip(tmp_path, single_runtime):
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
     assert int(restored["step"]) == 5
     ckpt.close()
+
+
+class TestRemotePaths:
+    """gs:// URIs must survive to the storage backend intact (a plain
+    ``Path.resolve()`` would mangle ``gs://bucket`` into ``gs:/bucket``
+    before Orbax or gfile ever saw it)."""
+
+    def test_uri_not_mangled(self):
+        ckpt = CheckpointDir("gs://bucket/run")
+        assert str(ckpt) == "gs://bucket/run"
+        assert str(ckpt.config_file) == "gs://bucket/run/config.yaml"
+        assert str(ckpt.state_dir) == "gs://bucket/run/state"
+
+    def test_generate_path_keeps_scheme(self):
+        p = generate_checkpoint_path("gs://bucket/experiments", "exp")
+        assert str(p).startswith("gs://bucket/experiments/exp-")
+
+    def test_local_paths_still_absolutised(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        ckpt = CheckpointDir("relative/run")
+        assert str(ckpt) == str(tmp_path / "relative" / "run")
+
+    def _redirect(self, tmp_path):
+        """Mock the epath backend so gs://test-bucket maps onto tmp_path —
+        exercises the real CheckpointDir code against the gfile API surface
+        without network access."""
+        import contextlib
+        import os
+        from unittest import mock
+
+        from etils.epath import gpath, testing as epath_testing
+
+        prefix = "gs://test-bucket"
+
+        def tr(p):
+            return os.fspath(p).replace(prefix, str(tmp_path))
+
+        def passthrough(original_fn, path, *args, **kwargs):
+            return original_fn(tr(path), *args, **kwargs)
+
+        ops = [
+            "exists", "isdir", "listdir", "mkdir", "makedirs", "open",
+            "glob", "remove", "rename", "replace", "stat", "walk", "copy",
+        ]
+        stack = contextlib.ExitStack()
+        # epath routes URI schemes straight to the tensorflow backend when TF
+        # is importable, bypassing the mocked backend table — disable that
+        # preference so the mock sees the gs:// calls
+        stack.enter_context(mock.patch.object(gpath, "_is_tf_installed", lambda: False))
+        stack.enter_context(epath_testing.mock_epath(**{op: passthrough for op in ops}))
+        return stack
+
+    def test_contract_files_on_mocked_gcs(self, tmp_path):
+        from dmlcloud_tpu.utils.config import Config
+
+        with self._redirect(tmp_path):
+            ckpt = CheckpointDir("gs://test-bucket/run1")
+            assert not ckpt.is_valid
+            ckpt.create()
+            assert ckpt.is_valid
+            assert (tmp_path / "run1" / ".dmlcloud_tpu").exists()  # landed "remotely"
+            ckpt.save_config(Config({"lr": 0.1, "model": {"width": 8}}))
+            loaded = ckpt.load_config()
+            assert loaded.get("lr") == 0.1
+            assert loaded.get("model").get("width") == 8
+
+    def test_atomic_write_text_remote_branch(self, tmp_path):
+        from dmlcloud_tpu.checkpoint import atomic_write_text, as_run_path
+
+        with self._redirect(tmp_path):
+            target = as_run_path("gs://test-bucket/meta.json")
+            atomic_write_text(target, '{"epoch": 3}')
+            assert (tmp_path / "meta.json").read_text() == '{"epoch": 3}'
+        # local branch goes through tmp+rename (no stray tmp file left)
+        local = as_run_path(str(tmp_path / "local.json"))
+        atomic_write_text(local, "x")
+        assert (tmp_path / "local.json").read_text() == "x"
+        assert not list(tmp_path.glob(".*.tmp"))
