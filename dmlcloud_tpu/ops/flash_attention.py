@@ -256,7 +256,8 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0. Returns [B, T, H, D].
 
     Sequence lengths must be multiples of the block sizes (pad upstream);
@@ -269,6 +270,12 @@ def flash_attention(
     VMEM, is the binding constraint on TPU: measured on v5e, 256x256 blocks
     LOSE to the unfused einsum path while 512x1024 is ~1.5x faster at S=4k
     and ~2.3x at S=8k (fwd, causal, d=64..128).
+
+    With ``return_lse=True`` returns ``(out, lse)`` where ``lse`` is the
+    per-row logsumexp of the scaled scores, shape [B, T, H] — the residual a
+    blockwise/ring combiner needs to merge partial attention outputs. This
+    path is differentiable in BOTH outputs (the lse cotangent folds into the
+    backward kernels' delta term, since d lse/d s = p).
     """
     b, t, h, d = q.shape
     if sm_scale is None:
@@ -282,9 +289,11 @@ def flash_attention(
         raise ValueError(
             f"causal flash attention requires equal Q/KV sequence lengths, got {t} != {k.shape[1]}"
         )
-    return _flash(
-        q, k, v, causal, float(sm_scale), _auto_block(block_q, t), _auto_block(block_k, k.shape[1]), bool(interpret)
-    )
+    bq, bk = _auto_block(block_q, t), _auto_block(block_k, k.shape[1])
+    if return_lse:
+        out, lse = _flash_lse(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret))
+        return out, lse.reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
+    return _flash(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -305,6 +314,33 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """(out, lse[B*H, T]) variant for blockwise/ring combiners."""
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, gs):
+    g_out, g_lse = gs
+    q, k, v, out, lse = residuals
+    # d lse_i / d s_ij = p_ij, so the lse cotangent enters the existing
+    # backward as ds += p * g_lse — algebraically a shift of the delta term:
+    # ds = p * (dp - (delta - g_lse)). Zero kernel changes needed.
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g_out, causal, sm_scale, block_q, block_k, interpret, lse_cotangent=g_lse
+    )
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def _fold_heads(x):
@@ -403,7 +439,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with
     return out
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, lse_cotangent=None):
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -416,6 +452,9 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, in
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term;
     # stats enter the kernels lane-broadcast ([B*H, T, _LANES], TPU tiling)
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)  # [B*H, T]
+    if lse_cotangent is not None:
+        # lse's own cotangent folds in as a delta shift (see _flash_lse_vjp_bwd)
+        delta = delta - lse_cotangent.astype(jnp.float32)
     delta3 = jnp.broadcast_to(delta[:, :, None], (b * h, t, _LANES))
     lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, t, _LANES))
     kv_index = _make_kv_index(h, kh)

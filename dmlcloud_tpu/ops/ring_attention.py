@@ -3,10 +3,20 @@
 The reference has no sequence-parallel machinery (SURVEY.md §5.7); this is the
 TPU build's long-context path. Activations are sharded along the sequence
 dimension over the ``seq`` mesh axis; K/V blocks rotate around the ring with
-``ppermute`` over ICI while each device accumulates its queries' attention
-online (flash-style running max/denominator), overlapping the collective with
-the blockwise compute. Memory per device is O(T/n); no device ever holds the
+``ppermute`` over ICI while each device merges its queries' attention against
+each visiting block. Memory per device is O(T/n); no device ever holds the
 full sequence — exact attention at arbitrary context length.
+
+The per-block attention IS the fused Pallas flash kernel
+(ops/flash_attention.py) called with ``return_lse=True``: operands stay in
+their native dtype (bf16 on the MXU), no [Tl, Tk] score matrix ever reaches
+HBM, and the visiting blocks' normalized outputs are merged with the
+standard blockwise combination — running max over block LSEs, exp-corrected
+weighted sum — carried in fp32. Under causal masking, ``lax.switch`` runs
+the non-causal kernel for blocks behind this device, the causal kernel for
+the diagonal block, and skips blocks ahead entirely (weight exp(-inf)).
+Gradients flow through the merge AND through the kernel's lse output
+(``_flash_lse`` custom_vjp).
 
 Two entry points:
 
@@ -20,12 +30,15 @@ Two entry points:
 
 from __future__ import annotations
 
-import math
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .flash_attention import flash_attention
+
+_NEG_INF = -1e30
 
 
 def ring_attention(
@@ -35,6 +48,9 @@ def ring_attention(
     axis_name: str = "seq",
     causal: bool = True,
     sm_scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on ``axis_name``.
 
@@ -43,51 +59,62 @@ def ring_attention(
     ``axis_name`` mapped. Returns [B, Tl, H, D].
     """
     b, tl, h, d = q.shape
-    kh = k.shape[2]
-    group = h // kh
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(d)
 
-    qg = (q.astype(jnp.float32) * sm_scale).reshape(b, tl, kh, group, d)
+    flash = partial(
+        flash_attention,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+        return_lse=True,
+    )
 
-    m0 = jnp.full((b, kh, group, tl), -1e30, jnp.float32)
-    l0 = jnp.zeros((b, kh, group, tl), jnp.float32)
-    acc0 = jnp.zeros((b, tl, kh, group, d), jnp.float32)
+    def behind_block(q, kb, vb):  # src strictly before this device: no mask
+        return flash(q, kb, vb, causal=False)
 
-    local_pos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
-    local_kpos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+    def diagonal_block(q, kb, vb):  # this device's own block: causal mask
+        return flash(q, kb, vb, causal=True)
+
+    def ahead_block(q, kb, vb):  # src strictly after: fully masked, skip
+        return (
+            jnp.zeros((b, tl, h, d), q.dtype),
+            jnp.full((b, tl, h), _NEG_INF, jnp.float32),
+        )
+
+    m0 = jnp.full((b, tl, h), _NEG_INF, jnp.float32)
+    w0 = jnp.zeros((b, tl, h), jnp.float32)
+    acc0 = jnp.zeros((b, tl, h, d), jnp.float32)
 
     def body(carry, step):
-        m, l, acc, kb, vb = carry
+        m, w, acc, kb, vb = carry
         src = (idx - step) % n  # which sequence block kb/vb holds
 
-        s = jnp.einsum("btkgd,bskd->bkgts", qg, kb.astype(jnp.float32))  # [B,KH,G,Tl,Tl]
         if causal:
-            # whole-block ordering + intra-block causal on the diagonal block
-            q_pos = idx * tl + local_pos
-            k_pos = src * tl + local_kpos
-            mask = q_pos >= k_pos
-            s = jnp.where(mask[None, None, None], s, -1e30)
+            branch = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+            out_b, lse_b = jax.lax.switch(
+                branch, [behind_block, diagonal_block, ahead_block], q, kb, vb
+            )
+        else:
+            out_b, lse_b = behind_block(q, kb, vb)
 
-        blk_max = jnp.max(s, axis=-1)
-        new_m = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m[..., None])  # [B,KH,G,Tl,Tk]
-        l = l * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bkgts,bskd->btkgd", p.astype(vb.dtype), vb).astype(jnp.float32)
-        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + pv
+        # blockwise merge of normalized partials: out = Σ_b exp(lse_b) out_b
+        # / Σ_b exp(lse_b), computed with a running max for stability
+        new_m = jnp.maximum(m, lse_b)
+        c_prev = jnp.exp(m - new_m)
+        c_new = jnp.exp(lse_b - new_m)
+        acc = acc * c_prev[..., None] + out_b.astype(jnp.float32) * c_new[..., None]
+        w = w * c_prev + c_new
 
         # rotate K/V around the ring (ICI neighbour exchange, overlaps compute)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (new_m, l, acc, kb, vb), None
+        return (new_m, w, acc, kb, vb), None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(body, (m0, l0, acc0, k, v), jnp.arange(n))
-    out = acc / l.transpose(0, 3, 1, 2)[..., None]
-    return out.reshape(b, tl, h, d).astype(q.dtype)
+    (m, w, acc, _, _), _ = jax.lax.scan(body, (m0, w0, acc0, k, v), jnp.arange(n))
+    return (acc / w[..., None]).astype(q.dtype)
 
 
 def ring_attention_sharded(
@@ -98,6 +125,9 @@ def ring_attention_sharded(
     axis_name: str = "seq",
     causal: bool = True,
     sm_scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Ring attention callable under plain jit: shard_maps itself over
     ``mesh`` with the sequence dim (axis 1) split on ``axis_name`` and batch
@@ -105,7 +135,15 @@ def ring_attention_sharded(
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
     spec_q = P(batch_axes, axis_name, None, None)
 
-    fn = partial(ring_attention, axis_name=axis_name, causal=causal, sm_scale=sm_scale)
+    fn = partial(
+        ring_attention,
+        axis_name=axis_name,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q, check_vma=False
     )(q, k, v)
