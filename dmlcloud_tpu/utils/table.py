@@ -4,8 +4,14 @@ The reference renders epochs through the third-party ``progress_table``
 package (/root/reference/dmlcloud/stage.py:147,188-205). That dependency isn't
 assumed here; this is a self-contained equivalent with the subset of the API
 the Stage layer needs: named columns, cell assignment, one printed row per
-epoch, and a close that draws the bottom border. Output is plain ASCII so it
-stays readable in ``log.txt`` tees and Slurm output files.
+epoch, live in-place updates of the in-progress row DURING the epoch
+(reference stage.py:188-205 UX), and a close that draws the bottom border.
+
+Live updates are carriage-return rewrites sent ONLY to a real terminal: when
+stdout is the IORedirector tee, the rewrite targets the underlying console
+stream so ``log.txt`` stays a clean one-row-per-epoch plain-ASCII record,
+and when output is not a TTY at all (Slurm files, CI) live rendering is
+disabled entirely.
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ class ProgressTable:
         self.row: dict[str, Any] = {}
         self._header_printed = False
         self._closed = False
+        self._live_pending = False
 
     def add_column(self, name: str, width: int | None = None, formatter: Callable[[Any], str] | None = None) -> None:
         if self._header_printed:
@@ -53,7 +60,7 @@ class ProgressTable:
         try:
             import numpy as np
 
-            if isinstance(value, np.ndarray) and value.ndim == 0:
+            if isinstance(value, np.floating) or (isinstance(value, np.ndarray) and value.ndim == 0):
                 return f"{float(value):.5g}"
         except Exception:
             pass
@@ -72,11 +79,50 @@ class ProgressTable:
         self._print(self._border("├", "┼", "┤"))
         self._header_printed = True
 
+    def live_target(self):
+        """The raw console stream for in-place rewrites, or None when live
+        rendering is off (not a TTY / non-root DevNullIO). Unwraps the
+        IORedirector tee so the rewrites never reach log.txt."""
+        stream = self.file
+        inner = getattr(stream, "stream", None)  # IORedirector._Tee wraps the console
+        if inner is not None and hasattr(inner, "write"):
+            stream = inner
+        try:
+            return stream if stream.isatty() else None
+        except Exception:
+            return None
+
+    def live(self, values: dict[str, Any]) -> None:
+        """Rewrite the in-progress row in place with ``values`` (unknown
+        column names ignored). No-op without a live console."""
+        target = self.live_target()
+        if target is None or self._closed or not self.columns:
+            return
+        for name, value in values.items():
+            if name in self.columns:
+                self.row[name] = value
+        if not self._header_printed:
+            self._print_header()
+        cells = " │ ".join(f"{self._fmt(c, self.row.get(c)):>{self.widths[c]}}" for c in self.columns)
+        target.write(f"\r│ {cells} │")
+        target.flush()
+        self._live_pending = True
+
+    def _finish_live(self) -> None:
+        if not self._live_pending:
+            return
+        target = self.live_target()
+        if target is not None:
+            target.write("\r")  # final row overwrites the live one (same width)
+            target.flush()
+        self._live_pending = False
+
     def next_row(self) -> None:
         if not self.columns:
             return
         if not self._header_printed:
             self._print_header()
+        self._finish_live()
         cells = " │ ".join(f"{self._fmt(c, self.row.get(c)):>{self.widths[c]}}" for c in self.columns)
         self._print(f"│ {cells} │")
         self.row = {}
@@ -84,6 +130,7 @@ class ProgressTable:
     def close(self) -> None:
         if self._closed:
             return
+        self._finish_live()
         if self._header_printed:
             self._print(self._border("└", "┴", "┘"))
         self._closed = True

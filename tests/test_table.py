@@ -1,0 +1,98 @@
+"""ProgressTable: per-epoch rows, live in-place updates (TTY only), and the
+tee-unwrapping that keeps log.txt free of carriage-return rewrites."""
+
+import io
+
+from dmlcloud_tpu.utils.table import ProgressTable
+
+
+class FakeTty(io.StringIO):
+    def isatty(self):
+        return True
+
+
+class FakeTee:
+    """Shape of IORedirector._Tee: console stream exposed as .stream."""
+
+    def __init__(self, console, log):
+        self.stream = console
+        self.log = log
+
+    def write(self, s):
+        self.stream.write(s)
+        self.log.write(s)
+
+    def flush(self):
+        pass
+
+    def isatty(self):
+        return True
+
+
+def _table(file):
+    t = ProgressTable(file=file)
+    t.add_column("Epoch")
+    t.add_column("Loss")
+    return t
+
+
+def test_rows_and_borders_plain_file():
+    buf = io.StringIO()
+    t = _table(buf)
+    t["Epoch"] = 1
+    t["Loss"] = 0.5
+    t.next_row()
+    t.close()
+    out = buf.getvalue()
+    assert out.count("\n") == 5  # top, header, sep, row, bottom
+    assert "0.5" in out and "\r" not in out
+
+
+def test_live_noop_without_tty():
+    buf = io.StringIO()  # isatty() False
+    t = _table(buf)
+    t.live({"Epoch": 1, "Loss": 0.1})
+    assert buf.getvalue() == ""  # nothing rendered, not even the header
+
+
+def test_live_rewrites_in_place_on_tty():
+    tty = FakeTty()
+    t = _table(tty)
+    t.live({"Epoch": 1, "Loss": 0.5})
+    t.live({"Epoch": 1, "Loss": 0.25})
+    out = tty.getvalue()
+    assert out.count("\r") == 2  # each live update rewrites the same line
+    assert "0.25" in out
+    t["Loss"] = 0.2
+    t.next_row()
+    assert tty.getvalue().rstrip().endswith("│")  # final row printed
+
+    t.live({"Loss": 0.9})
+    t.close()  # close with a live row pending must clear it before the border
+    assert tty.getvalue().endswith("┘\n")
+
+
+def test_live_unknown_column_ignored():
+    tty = FakeTty()
+    t = _table(tty)
+    t.live({"nope": 1, "Loss": 0.5})
+    assert "0.5" in tty.getvalue()
+
+
+def test_tee_unwrapped_log_stays_clean():
+    """Live rewrites go to the console inside the tee; the log only ever sees
+    whole rows."""
+    console, log = FakeTty(), io.StringIO()
+    tee = FakeTee(console, log)
+    t = _table(tee)
+    t.live({"Epoch": 1, "Loss": 0.5})
+    t.live({"Epoch": 1, "Loss": 0.4})
+    assert "\r" in console.getvalue()
+    assert "\r" not in log.getvalue()  # header lines only
+    t["Loss"] = 0.3
+    t.next_row()
+    t.close()
+    assert "\r" not in log.getvalue()
+    assert "0.3" in log.getvalue()  # final row did reach the log
+    # and the header was printed exactly once
+    assert log.getvalue().count("Epoch") == 1
