@@ -31,6 +31,28 @@ IMG = 224
 WARMUP_STEPS = 5
 TIMED_STEPS = 30
 
+#: ResNet-50 v1.5 @ 224^2: ~4.1 GFLOPs forward; training ~= 3x forward
+#: (backward ~2x). Used for MFU: images/s x FLOPs/image / chip peak.
+TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
+
+#: bf16 peak by TPU generation (chip). Fallback 197e12 (v5e) when unknown.
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def chip_peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return 197e12
+
 
 def synthetic_batch(rng: np.random.RandomState):
     return {
@@ -270,6 +292,8 @@ def main():
                 "vs_baseline": round(fw_ips / raw_ips, 4),
                 "extras": {
                     "raw_images_per_sec": round(raw_ips, 2),
+                    "mfu": round(fw_ips * TRAIN_FLOPS_PER_IMAGE / chip_peak_flops(), 4),
+                    "raw_mfu": round(raw_ips * TRAIN_FLOPS_PER_IMAGE / chip_peak_flops(), 4),
                     "flash_attn_tokens_per_sec_s8k": round(flash_tps, 1),
                     "flash_attn_speedup_vs_unfused_s8k": round(flash_speedup, 3),
                     "metrics_allreduce_p50_ms_8proc_12metrics": (

@@ -104,11 +104,9 @@ class DataPipeline(_DatasetBase):
                 iterable.set_epoch(epoch)
             return iter(iterable)
 
-        try:
-            n = len(iterable)  # type: ignore[arg-type]
-            length = lambda: n  # noqa: E731
-        except TypeError:
-            length = None
+        # length evaluated lazily — a source whose len changes after wrapping
+        # (list extended before training, curriculum datasets) stays truthful
+        length = (lambda: len(iterable)) if hasattr(iterable, "__len__") else None
         return cls(make, length)
 
     @classmethod
@@ -442,15 +440,43 @@ class ShardedSequenceDataset(_ReconstructOnUnpickle, DataPipeline):
 
 
 class ShardedXrDataset(_ReconstructOnUnpickle, DataPipeline):
-    """Reference-parity shim over ``DataPipeline.from_chunked``
-    (reference data.py:150-207)."""
+    """Reference-parity shim over ``DataPipeline.from_chunked``; the full
+    positional parameter order matches reference data.py:150-207 including
+    the ``process_group`` slot (meaningless here — JAX has one global
+    runtime — but kept so positional callers' ``load``/``load_kwargs``
+    don't silently shift)."""
 
-    def __init__(self, ds: Any, dim: str, chunk_size: int, **kwargs):
-        kwargs.setdefault("rank", runtime.rank())
-        kwargs.setdefault("world_size", runtime.world_size())
-        self._ctor_args = (ds, dim, chunk_size)
-        self._ctor_kwargs = dict(kwargs)
-        p = DataPipeline.from_chunked(ds, dim, chunk_size, **kwargs)
+    def __init__(
+        self,
+        ds: Any,
+        dim: str,
+        chunk_size: int,
+        chunk_overlap: int = 0,
+        even_shards: bool = True,
+        equal_chunks: bool = True,
+        shuffle: bool = False,
+        seed: int = 0,
+        rank: int | None = None,
+        world_size: int | None = None,
+        process_group: Any = None,
+        load: bool = False,
+        load_kwargs: dict | None = None,
+    ):
+        if process_group is not None:
+            raise ValueError(
+                "process_group is a torch.distributed concept; the JAX runtime has a "
+                "single global process group — pass rank/world_size instead"
+            )
+        rank = runtime.rank() if rank is None else rank
+        world_size = runtime.world_size() if world_size is None else world_size
+        self._ctor_args = (ds, dim, chunk_size, chunk_overlap, even_shards, equal_chunks,
+                           shuffle, seed, rank, world_size, None, load, load_kwargs)
+        self._ctor_kwargs = {}
+        p = DataPipeline.from_chunked(
+            ds, dim, chunk_size, chunk_overlap=chunk_overlap, even_shards=even_shards,
+            equal_chunks=equal_chunks, shuffle=shuffle, seed=seed, rank=rank,
+            world_size=world_size, load=load, load_kwargs=load_kwargs,
+        )
         super().__init__(p._make_iter, p._length_fn)
         self.ds = ds
 

@@ -257,7 +257,7 @@ class TrainingPipeline:
         def initializer():
             wandb_set_startup_timeout(startup_timeout)
             _wandb.init(
-                config=self.config.to_dict(),
+                config=self.config.to_dict(resolve=True),
                 name=self.name,
                 entity=entity,
                 project=project if project else self.name,
@@ -354,7 +354,7 @@ class TrainingPipeline:
         devices = runtime.all_gather_object(local_desc)
         diagnostics += "\n".join(f"    - [Process {i}] {d}" for i, d in enumerate(devices))
         diagnostics += "\n* CONFIG:\n"
-        diagnostics += "\n".join(f"    {line}" for line in self.config.to_yaml().splitlines())
+        diagnostics += "\n".join(f"    {line}" for line in self.config.to_yaml(resolve=True).splitlines())
         self.logger.info(diagnostics)
 
         self.pre_run()

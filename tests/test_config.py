@@ -1,0 +1,121 @@
+"""Config container: attribute/dict access, YAML round-trip, and the
+OmegaConf-style ``${...}`` interpolation semantics the reference relies on
+(/root/reference/dmlcloud/pipeline.py:154,269-270, checkpoint.py:105-117)."""
+
+import pytest
+
+from dmlcloud_tpu.utils.config import Config, InterpolationError, as_config
+
+
+class TestBasics:
+    def test_attribute_and_item_access(self):
+        cfg = Config({"model": {"width": 8}, "lr": 0.1})
+        assert cfg.model.width == 8
+        assert cfg["model"]["width"] == 8
+        assert cfg.get("missing", 3) == 3
+
+    def test_yaml_roundtrip(self, tmp_path):
+        cfg = Config({"a": 1, "nested": {"b": [1, 2]}})
+        cfg.save(tmp_path / "c.yaml")
+        loaded = Config.load(tmp_path / "c.yaml")
+        assert loaded.to_dict() == cfg.to_dict()
+
+    def test_as_config(self):
+        assert as_config(None).to_dict() == {}
+        assert as_config({"x": 1}).x == 1
+        with pytest.raises(TypeError):
+            as_config(42)
+
+
+class TestInterpolation:
+    def test_typed_reference(self):
+        cfg = Config({"model": {"width": 128}, "head_dim": "${model.width}"})
+        assert cfg.head_dim == 128  # int, not "128"
+
+    def test_string_substitution(self):
+        cfg = Config({"name": "run", "out": "results/${name}/logs"})
+        assert cfg.out == "results/run/logs"
+
+    def test_chained_references(self):
+        cfg = Config({"a": 4, "b": "${a}", "c": "${b}"})
+        assert cfg.c == 4
+
+    def test_reference_from_nested_node(self):
+        cfg = Config({"lr": 0.1, "optim": {"lr": "${lr}"}})
+        assert cfg.optim.lr == 0.1  # resolved against the ROOT
+
+    def test_dangling_reference_raises(self):
+        cfg = Config({"x": "${nope.deep}"})
+        with pytest.raises(InterpolationError, match="does not resolve"):
+            _ = cfg.x
+
+    def test_cycle_raises(self):
+        cfg = Config({"a": "${b}", "b": "${a}"})
+        with pytest.raises(InterpolationError, match="cycle"):
+            _ = cfg.a
+
+    def test_env_resolver(self, monkeypatch):
+        monkeypatch.setenv("DML_TEST_VAR", "hello")
+        cfg = Config({"x": "${env:DML_TEST_VAR}", "y": "${env:DML_MISSING_VAR,fallback}"})
+        assert cfg.x == "hello"
+        assert cfg.y == "fallback"
+        with pytest.raises(InterpolationError, match="not set"):
+            _ = Config({"z": "${env:DML_MISSING_VAR}"}).z
+
+    def test_to_dict_resolved_vs_raw(self):
+        cfg = Config({"a": 2, "b": "${a}"})
+        assert cfg.to_dict() == {"a": 2, "b": "${a}"}  # raw by default
+        assert cfg.to_dict(resolve=True) == {"a": 2, "b": 2}
+        assert "${a}" in cfg.to_yaml()
+        assert "${a}" not in cfg.to_yaml(resolve=True)
+
+    def test_save_keeps_interpolations(self, tmp_path):
+        """Like OmegaConf.save: the stored config keeps ${...} so a resumed
+        run re-resolves against its (possibly overridden) context."""
+        cfg = Config({"a": 1, "b": "${a}"})
+        cfg.save(tmp_path / "c.yaml")
+        loaded = Config.load(tmp_path / "c.yaml")
+        loaded["a"] = 7
+        assert loaded.b == 7
+
+    def test_resolve_materialises(self):
+        frozen = Config({"a": 1, "b": "${a}"}).resolve()
+        frozen["a"] = 9
+        assert frozen.b == 1  # no longer linked
+
+    def test_node_alias_resolves_and_dumps(self):
+        """A whole-string interpolation may target a mapping node; resolved
+        dumps must produce plain YAML, and access must traverse the alias."""
+        cfg = Config({"model": {"lr": 0.1}, "alias": "${model}"})
+        assert cfg.alias.lr == 0.1
+        d = cfg.to_dict(resolve=True)
+        assert d["alias"] == {"lr": 0.1} and type(d["alias"]) is dict
+        assert "lr: 0.1" in cfg.to_yaml(resolve=True)  # no RepresenterError
+
+    def test_interpolation_inside_lists(self):
+        cfg = Config({"w": 5, "layers": [{"dim": "${w}"}, "${w}"]})
+        assert cfg.layers == [{"dim": 5}, 5]
+        assert cfg.to_dict(resolve=True)["layers"] == [{"dim": 5}, 5]
+
+    def test_assigning_subconfig_does_not_corrupt_source(self):
+        base = Config({"a": 1, "m": {"x": "${a}"}})
+        other = Config({})
+        other["m"] = base["m"]  # copies; must NOT re-parent base's node
+        assert base.m.x == 1  # source tree still resolves
+        with pytest.raises(InterpolationError):
+            _ = other.m.x  # the copy resolves against ITS root, which lacks 'a'
+
+    def test_copying_config_keeps_interpolations_raw(self):
+        cfg = Config({"port": "${env:DML_UNSET_PORT,8080}", "opt": "${maybe.later}"})
+        copy = Config(cfg)  # must not materialise or raise
+        assert copy.to_dict() == cfg.to_dict()
+        copy["maybe"] = {"later": 3}
+        assert copy.opt == 3
+
+    def test_xr_process_group_positional_slot(self):
+        """The reference signature has process_group at position 11; passing
+        one must raise, not silently shift load/load_kwargs."""
+        from dmlcloud_tpu.data import ShardedXrDataset
+
+        with pytest.raises(ValueError, match="process_group"):
+            ShardedXrDataset(None, "t", 2, 0, True, True, False, 0, 0, 1, object(), True)
