@@ -37,6 +37,16 @@ class InterpolationError(ValueError):
     pass
 
 
+def _needs_resolution(value: Any) -> bool:
+    if isinstance(value, str):
+        return "${" in value
+    if isinstance(value, (list, tuple)):
+        return any(_needs_resolution(v) for v in value)
+    if isinstance(value, dict):
+        return any(_needs_resolution(v) for v in value.values())
+    return False
+
+
 def _resolve_ref(expr: str, root: "Config", active: frozenset) -> Any:
     expr = expr.strip()
     if expr.startswith("env:"):
@@ -49,24 +59,43 @@ def _resolve_ref(expr: str, root: "Config", active: frozenset) -> Any:
         raise InterpolationError(f"environment variable {name.strip()!r} is not set and has no default")
     if expr in active:
         raise InterpolationError(f"interpolation cycle through ${{{expr}}}")
+    active = active | {expr}
     node: Any = root
     for part in expr.split("."):
+        if isinstance(node, str) and "${" in node:
+            # an intermediate segment may itself be an alias ("${alias.lr}"
+            # where alias = "${model}") — resolve before indexing into it
+            node = _resolve_value(node, root, active)
         try:
             node = node._data[part] if isinstance(node, Config) else node[part]
         except (KeyError, TypeError, IndexError):
             raise InterpolationError(f"interpolation ${{{expr}}} does not resolve to a key") from None
-    return _resolve_value(node, root, active | {expr})
+    return _resolve_value(node, root, active)
+
+
+def _substitute(match: "re.Match", root: "Config", active: frozenset) -> str:
+    value = _resolve_ref(match.group(1), root, active)
+    if isinstance(value, (Config, dict, list, tuple)):
+        raise InterpolationError(
+            f"cannot substitute ${{{match.group(1).strip()}}} into a string: "
+            f"it resolves to a {type(value).__name__} node, not a scalar"
+        )
+    return str(value)
 
 
 def _resolve_value(value: Any, root: "Config", active: frozenset = frozenset()) -> Any:
     """Resolve interpolations in a raw value, recursing into lists/tuples and
     plain dicts. A string that is exactly one ``${...}`` keeps the referenced
-    value's type; embedded occurrences are substituted as strings."""
-    if isinstance(value, str) and "${" in value:
+    value's type; embedded occurrences are substituted as strings (scalar
+    targets only). Values with no interpolation anywhere are returned AS
+    STORED — container reads stay live objects that callers may mutate."""
+    if not _needs_resolution(value):
+        return value
+    if isinstance(value, str):
         whole = _INTERP.fullmatch(value.strip())
         if whole:
             return _resolve_ref(whole.group(1), root, active)
-        return _INTERP.sub(lambda m: str(_resolve_ref(m.group(1), root, active)), value)
+        return _INTERP.sub(lambda m: _substitute(m, root, active), value)
     if isinstance(value, (list, tuple)):
         return type(value)(_resolve_value(v, root, active) for v in value)
     if isinstance(value, dict):

@@ -112,6 +112,23 @@ class TestInterpolation:
         copy["maybe"] = {"later": 3}
         assert copy.opt == 3
 
+    def test_container_values_stay_live_without_interpolation(self):
+        """Reads of plain containers return the STORED object — in-place
+        mutation must persist (only interpolation-bearing values rebuild)."""
+        cfg = Config({"tags": ["a"]})
+        cfg.tags.append("b")
+        assert cfg.tags == ["a", "b"]
+
+    def test_reference_through_alias_segment(self):
+        """A dotted path whose intermediate segment is itself an alias."""
+        cfg = Config({"model": {"lr": 0.1}, "alias": "${model}", "x": "${alias.lr}"})
+        assert cfg.x == 0.1
+
+    def test_string_substitution_of_node_raises(self):
+        cfg = Config({"model": {"lr": 0.1}, "p": "out/${model}"})
+        with pytest.raises(InterpolationError, match="not a scalar"):
+            _ = cfg.p
+
     def test_xr_process_group_positional_slot(self):
         """The reference signature has process_group at position 11; passing
         one must raise, not silently shift load/load_kwargs."""

@@ -49,7 +49,8 @@ def test_smoke_pipeline(single_runtime, capsys):
     # auto-metrics present
     assert pipeline.tracker["misc/total_train_batches"][0] == 1
     assert pipeline.tracker["misc/worker_train_batches"][0] == 1
-    assert pipeline.tracker["misc/step_time_ms"][0] is not None
+    assert pipeline.tracker["misc/step_dispatch_ms"][0] is not None
+    assert pipeline.tracker["misc/train_step_avg_ms"][0] is not None
     # state advanced on device
     assert int(jax.device_get(stage.state.step)) == 2
     # table rendered
