@@ -23,6 +23,7 @@ objects if the user passes one (duck-typed via ``to_container``).
 
 from __future__ import annotations
 
+import copy
 import os
 import re
 from pathlib import Path
@@ -149,6 +150,11 @@ class Config(Mapping):
             value = Config(value)
         elif isinstance(value, Mapping):
             value = Config(value)
+        elif isinstance(value, (list, tuple)):
+            # lists are stored by value too — reads return the stored object
+            # live (mutation persists), so sharing it across configs would
+            # let a "copy" mutate its source
+            value = copy.deepcopy(value)
         if isinstance(value, Config):
             object.__setattr__(value, "_parent", self)
         self._data[key] = value

@@ -49,6 +49,9 @@ class LMStage(dml.TrainValStage):
             vocab_size=cfg.vocab_size,
             max_seq_len=cfg.seq_len,
             attn_impl=cfg.attn,
+            # ring attention under plain jit needs the mesh to shard_map
+            # itself over the seq axis; dot/flash are mesh-agnostic
+            mesh=self.mesh if cfg.attn == "ring" else None,
             **PRESETS[cfg.preset],
         )
         model = DecoderLM(model_cfg)

@@ -119,6 +119,16 @@ class TestInterpolation:
         cfg.tags.append("b")
         assert cfg.tags == ["a", "b"]
 
+    def test_copies_do_not_share_list_storage(self):
+        """Forking a config must not alias mutable containers: mutating the
+        fork (or the original) stays local to it."""
+        base = Config({"tags": ["a"], "nested": {"xs": [1]}})
+        fork = Config(base)
+        fork.tags.append("debug")
+        fork.nested.xs.append(2)
+        assert base.tags == ["a"]
+        assert base.nested.xs == [1]
+
     def test_reference_through_alias_segment(self):
         """A dotted path whose intermediate segment is itself an alias."""
         cfg = Config({"model": {"lr": 0.1}, "alias": "${model}", "x": "${alias.lr}"})
