@@ -84,3 +84,71 @@ def test_print_helpers(single_runtime, capsys):
     out = capsys.readouterr().out
     assert "hello" in out
     assert "Worker 0 (0.0): there" in out
+
+
+class _FakeClient:
+    """Coordination-client stub for barrier logic: records arrival keys and
+    raises a scripted error from wait_at_barrier."""
+
+    def __init__(self, wait_error=None, present_keys=()):
+        self.kv = {k: "1" for k in present_keys}
+        self.wait_error = wait_error
+
+    def key_value_set(self, key, value):
+        self.kv[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.kv:
+            return self.kv[key]
+        raise RuntimeError("DEADLINE_EXCEEDED: key not found")
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+    def wait_at_barrier(self, barrier_id, timeout_in_ms):
+        if self.wait_error is not None:
+            raise self.wait_error
+
+
+class TestBarrierDiagnostics:
+    """barrier() behavior on failure, driven through a fake client so the
+    classification logic is testable at world size 1."""
+
+    def _run_barrier(self, monkeypatch, client, world=4, my_rank=0):
+        monkeypatch.setattr(runtime, "_client", lambda: client)
+        monkeypatch.setattr(runtime, "world_size", lambda: world)
+        monkeypatch.setattr(runtime, "rank", lambda: my_rank)
+        runtime.barrier("unit", timeout=1)
+
+    def test_timeout_names_stragglers(self, single_runtime, monkeypatch):
+        client = _FakeClient(wait_error=RuntimeError("DEADLINE_EXCEEDED while waiting"))
+        # ranks 1..3 never arrive: only this rank's key gets set by barrier()
+        with pytest.raises(runtime.BarrierTimeout) as exc:
+            self._run_barrier(monkeypatch, client)
+        # rank 0 arrived (its own key), 1..3 did not
+        assert exc.value.stragglers == [1, 2, 3]
+        assert "straggler" in str(exc.value)
+
+    def test_timeout_with_all_arrived_reports_empty(self, single_runtime, monkeypatch):
+        barrier_keys = [f"dmlcloud_tpu:unit:{runtime._seq['barrier'] + 1}/arrived/{r}" for r in range(4)]
+        client = _FakeClient(
+            wait_error=RuntimeError("deadline exceeded"), present_keys=barrier_keys
+        )
+        with pytest.raises(runtime.BarrierTimeout) as exc:
+            self._run_barrier(monkeypatch, client)
+        assert exc.value.stragglers == []
+        assert "unknown" in str(exc.value)
+
+    def test_non_timeout_error_not_misdiagnosed(self, single_runtime, monkeypatch):
+        """A lost coordinator connection must re-raise as-is, not masquerade
+        as a timeout with phantom stragglers."""
+        client = _FakeClient(wait_error=ConnectionError("coordinator connection reset"))
+        with pytest.raises(ConnectionError, match="connection reset"):
+            self._run_barrier(monkeypatch, client)
+
+    def test_success_leaves_arrival_key(self, single_runtime, monkeypatch):
+        """Arrival keys persist after a successful barrier — deleting them
+        would let a marginal-race prober misname arrived ranks."""
+        client = _FakeClient()
+        self._run_barrier(monkeypatch, client)
+        assert any("/arrived/0" in k for k in client.kv)
