@@ -42,6 +42,16 @@ class EncoderConfig:
     # recompute each block in the backward pass (gradient rematerialisation):
     # O(1) blocks of live activation memory for ~1/3 more FLOPs
     remat: bool = False
+    # 'dot' (einsum softmax) | 'flash' (fused Pallas kernel; unmasked
+    # sequences only — padded batches fall back to 'dot' per call). Sequence
+    # length must be a multiple of 64 for 'flash' (ViT-B/L's 197 tokens is
+    # not; pad or keep 'dot' there).
+    attn_impl: str = "dot"
+
+    def __post_init__(self):
+        if self.attn_impl not in ("dot", "flash"):
+            # a typo here would otherwise silently run the unfused path
+            raise ValueError(f"attn_impl must be 'dot' or 'flash', got {self.attn_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -109,15 +119,23 @@ class EncoderAttention(nn.Module):
         k = dense("k_proj")(x)
         v = dense("v_proj")(x)
 
-        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
-        scores = scores / jnp.sqrt(cfg.head_dim)
-        if cfg.causal:
-            causal = jnp.tril(jnp.ones((t, t), dtype=bool))
-            scores = jnp.where(causal[None, None], scores, NEG_INF)
-        if mask_bias is not None:
-            scores = scores + mask_bias
-        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        if cfg.attn_impl == "flash" and mask_bias is None:
+            # fused Pallas path (ops/flash_attention.py); padding masks need
+            # the additive-bias path below, so BERT-style padded batches fall
+            # back automatically while ViT/CLIP towers (no mask) fuse
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=cfg.causal)
+        else:
+            scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+            scores = scores / jnp.sqrt(cfg.head_dim)
+            if cfg.causal:
+                causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+                scores = jnp.where(causal[None, None], scores, NEG_INF)
+            if mask_bias is not None:
+                scores = scores + mask_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v)
         return nn.DenseGeneral(
             cfg.hidden_dim,
             axis=(-2, -1),

@@ -64,6 +64,11 @@ class TransformerConfig:
     # [T, S] score matrix).
     remat: bool = False
 
+    def __post_init__(self):
+        if self.attn_impl not in ("dot", "flash", "ring"):
+            # a typo here would otherwise silently run the unfused path
+            raise ValueError(f"attn_impl must be 'dot', 'flash' or 'ring', got {self.attn_impl!r}")
+
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
