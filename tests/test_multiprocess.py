@@ -172,6 +172,45 @@ def test_barrier_timeout_names_stragglers(tmp_path):
     assert "STRAGGLERS [1]" in outs[0]
 
 
+def test_fsdp_sharded_checkpoint_across_processes(tmp_path):
+    """Params sharded over an fsdp axis spanning BOTH processes' devices:
+    Orbax saves each host's shards in parallel and restores them with the
+    original sharding — the multi-host checkpointing claim, executed."""
+    _spawn(
+        tmp_path,
+        """
+        import jax, jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dmlcloud_tpu.checkpoint import CheckpointDir
+        from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.create_mesh({{"fsdp": 2}})
+        sharding = NamedSharding(mesh, P("fsdp"))
+        # a global [8, 4] array, rows 0-3 on process 0, rows 4-7 on process 1
+        local = np.arange(16, dtype=np.float32).reshape(4, 4) + 100 * RANK
+        arr = jax.make_array_from_process_local_data(sharding, local)
+
+        ckpt = CheckpointDir({ckpt!r})
+        if rt.is_root() and not ckpt.is_valid:
+            ckpt.create()
+        rt.barrier("created", timeout=60)
+        ckpt.save_state(1, {{"w": arr}}, scope="fsdp_stage")
+        ckpt.wait_until_finished()
+        rt.barrier("saved", timeout=120)
+
+        template = {{"w": jax.device_put(jnp.zeros((8, 4)), sharding)}}
+        restored = ckpt.restore_state(1, template=template, scope="fsdp_stage")["w"]
+        assert restored.sharding.spec == P("fsdp"), restored.sharding
+        # every process checks ITS addressable shard round-tripped
+        for shard in restored.addressable_shards:
+            np.testing.assert_array_equal(np.asarray(shard.data), local)
+        ckpt.close()
+        print("FSDP-CKPT-OK", RANK)
+        """.format(ckpt=str(tmp_path / "fsdp_run")),
+        timeout=300,
+    )
+
+
 def test_pipeline_train_and_resume_two_processes(tmp_path):
     """End-to-end: a 2-process pipeline (mesh spanning both processes' CPU
     devices, global-batch step, Orbax collective checkpointing) trains 2
