@@ -14,6 +14,7 @@ Prints ONE JSON line; ``value`` is the framework-path throughput and
 reference's equivalent overhead is its Python hot loop, stage.py:298-314).
 """
 
+import functools
 import json
 import time
 
@@ -73,7 +74,10 @@ def bench_raw(batch) -> float:
     params, batch_stats = variables["params"], variables["batch_stats"]
     opt_state = tx.init(params)
 
-    @jax.jit
+    # donate the state buffers like the framework path does (stage.py jit
+    # donate_argnums) — otherwise the raw "ceiling" pays an extra whole-model
+    # copy per step that no real training loop would
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, batch):
         def loss_fn(p):
             logits, new_state = model.apply(
