@@ -285,6 +285,20 @@ class TrainValStage(Stage):
         """Global-norm clip threshold; 0 disables (reference stage.py:256-257)."""
         return 0.0
 
+    def gradient_accumulation(self) -> int:
+        """Number of microbatches to accumulate per optimizer step (1
+        disables). The registered batch is split along its leading axis and
+        scanned with ``lax.scan`` INSIDE the one compiled step — grads and
+        metrics accumulate in fp32 on device, the optimizer applies once.
+        Losses and grads are AVERAGED over microbatches, so equivalence with
+        the unaccumulated step requires ``step`` to return a mean-reduced
+        loss (a sum-reduced loss would be rescaled by 1/accum).
+        This is the TPU shape of large effective batches under a tight HBM
+        budget: one trace, one dispatch, no host round trips per microbatch.
+        (The reference has no equivalent; its imperative loop would pay
+        ``accum`` Python dispatches, stage.py:290-314.)"""
+        return 1
+
     def model_name(self) -> str | None:
         """Which registered model this stage trains (None = the only one)."""
         return None
@@ -349,22 +363,27 @@ class TrainValStage(Stage):
     # -- compiled steps -----------------------------------------------------
     def _build_train_step(self) -> Callable:
         clip = float(self.gradient_clip())
+        accum = int(self.gradient_accumulation())
 
         def train_step(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.step)
 
-            def loss_fn(params):
-                out = self.train_step(state.replace(params=params, rng=rng), batch)
+            def loss_fn(params, extras, rng, mb):
+                out = self.train_step(state.replace(params=params, extras=extras, rng=rng), mb)
                 # step may return loss | (loss, metrics) | (loss, metrics, new_extras)
                 if not isinstance(out, tuple):
-                    loss, metrics, extras = out, {}, state.extras
+                    loss, metrics, new_extras = out, {}, extras
                 elif len(out) == 2:
-                    (loss, metrics), extras = out, state.extras
+                    (loss, metrics), new_extras = out, extras
                 else:
-                    loss, metrics, extras = out
-                return loss, (metrics, extras)
+                    loss, metrics, new_extras = out
+                return loss, (metrics, new_extras)
 
-            (loss, (metrics, new_extras)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            if accum == 1:
+                (loss, (metrics, new_extras)), grads = grad_fn(state.params, state.extras, rng, batch)
+            else:
+                loss, metrics, new_extras, grads = self._accumulate(grad_fn, state, rng, batch, accum)
             if clip > 0.0:
                 gnorm = jax.lax.rsqrt(
                     jnp.maximum(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in jax.tree_util.tree_leaves(grads)), 1e-12)
@@ -384,6 +403,55 @@ class TrainValStage(Stage):
             in_shardings=(state_sh, batch_sh),
             out_shardings=(state_sh, None),
         )
+
+    @staticmethod
+    def _accumulate(grad_fn, state, rng, batch, accum):
+        """Traced microbatch accumulation: split ``batch`` [B, ...] into
+        ``accum`` slices of B/accum and ``lax.scan`` ``grad_fn`` over them.
+        Losses, metrics, and grads accumulate in fp32 (grads cast back to
+        the param dtype for the optimizer); auxiliary state (``extras``,
+        e.g. BatchNorm stats) threads through the scan so the last
+        microbatch's update wins, exactly as sequential steps would."""
+        leaves = jax.tree_util.tree_leaves(batch)
+        for leaf in leaves:
+            if leaf.shape[0] % accum:
+                raise ValueError(
+                    f"gradient_accumulation()={accum} must divide the batch dimension, got {leaf.shape[0]}"
+                )
+        micro = jax.tree_util.tree_map(lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch)
+
+        # One eval_shape reveals the metrics pytree so the fp32 accumulators
+        # can be preallocated for the scan carry.
+        first = jax.tree_util.tree_map(lambda x: x[0], micro)
+        out_shape = jax.eval_shape(grad_fn, state.params, state.extras, rng, first)
+        metrics_shape = out_shape[0][1][0]
+
+        def f32_zeros(tree):
+            return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, jnp.float32), tree)
+
+        init = (
+            f32_zeros(state.params),  # grad accumulators
+            state.extras,
+            jnp.zeros((), jnp.float32),  # loss
+            f32_zeros(metrics_shape),
+        )
+
+        def body(carry, xs):
+            grads_acc, extras, loss_acc, metrics_acc = carry
+            i, mb = xs
+            (loss, (metrics, new_extras)), grads = grad_fn(state.params, extras, jax.random.fold_in(rng, i), mb)
+            grads_acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+            metrics_acc = jax.tree_util.tree_map(lambda a, m: a + m.astype(jnp.float32), metrics_acc, metrics)
+            return (grads_acc, new_extras, loss_acc + loss.astype(jnp.float32), metrics_acc), None
+
+        (grads_acc, extras, loss_acc, metrics_acc), _ = jax.lax.scan(
+            body, init, (jnp.arange(accum), micro)
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / accum).astype(p.dtype), grads_acc, state.params
+        )
+        metrics = jax.tree_util.tree_map(lambda m: m / accum, metrics_acc)
+        return loss_acc / accum, metrics, extras, grads
 
     def _build_val_step(self) -> Callable:
         def val_step(state: TrainState, batch):
