@@ -1,0 +1,125 @@
+"""Autoregressive text generation for DecoderLM — the inference half the
+training stack feeds into (the reference ships no inference path at all;
+this is TPU-side scope).
+
+TPU-first shape of the problem:
+
+- The KV cache is a static-shape pytree ([B, max_len, KH, D] per layer,
+  bf16); every decode step writes one slot with ``dynamic_update_slice``
+  and attends over the full buffer with the unwritten tail masked — no
+  dynamic shapes anywhere, so the whole loop compiles once.
+- Generation is ONE jitted program: prefill over the (padded) prompt, then
+  ``lax.scan`` over decode steps. No per-token Python dispatch; the only
+  host transfer is the final token matrix.
+- Sampling is functional: greedy at ``temperature=0``, otherwise
+  temperature softmax with optional top-k truncation, PRNG folded per step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import DecoderLM, TransformerConfig
+
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int | None = None, dtype=jnp.bfloat16):
+    """Zeroed KV cache pytree: ``{layer_i: {k, v: [B, S, KH, D]}}``."""
+    s = max_len or cfg.max_seq_len
+    shape = (batch_size, s, cfg.kv_heads, cfg.head_dim)
+    return {
+        f"layer_{i}": {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for i in range(cfg.num_layers)
+    }
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits: [B, V] fp32 -> tokens [B] int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_id", "pad_id")
+)
+def _generate_compiled(
+    model: DecoderLM,
+    params,
+    prompt: jnp.ndarray,
+    rng: jax.Array,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    eos_id: int,
+    pad_id: int,
+):
+    b, t = prompt.shape
+    # cache in the model's compute dtype so fp32 configs stay exact
+    cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
+
+    # Prefill: one pass over the whole prompt fills cache slots [0, t).
+    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0)
+    last = logits[:, -1]  # [B, V]
+
+    def sample_next(prev_logits, rng, done):
+        tok = _sample(prev_logits, rng, temperature, top_k)
+        tok = jnp.where(done, pad_id, tok)
+        return tok, done | (tok == eos_id)
+
+    def step(carry, i):
+        cache, prev_logits, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        tok, done = sample_next(prev_logits, sub, done)
+        logits, cache = model.apply({"params": params}, tok[:, None], cache=cache, offset=t + i)
+        return (cache, logits[:, 0], rng, done), tok
+
+    # scan N-1 decode steps; the Nth token needs only a sample, not another
+    # forward pass (whose logits nothing would consume)
+    init = (cache, last, rng, jnp.zeros((b,), bool))
+    (cache, last, rng, done), tokens = jax.lax.scan(step, init, jnp.arange(max_new_tokens - 1))
+    final_tok, _ = sample_next(last, jax.random.split(rng)[1], done)
+    return jnp.concatenate([tokens, final_tok[None]], axis=0).T  # [B, max_new_tokens]
+
+
+def generate(
+    model: DecoderLM,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int = 32,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T] int32
+    (uniform prompt length across the batch). Greedy when
+    ``temperature == 0``; otherwise temperature sampling with optional
+    ``top_k`` truncation. Rows that emit ``eos_id`` keep emitting
+    ``pad_id``. Returns [B, max_new_tokens] int32.
+
+    The whole generation — prefill + scan over decode steps — is one
+    compiled program; recompiles happen only when shapes or the static
+    knobs change.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    if t + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds max_seq_len ({model.cfg.max_seq_len})"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_compiled(
+        model, params, prompt, rng,
+        int(max_new_tokens), float(temperature), int(top_k), int(eos_id), int(pad_id),
+    )

@@ -124,15 +124,18 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int =
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
-def _dot_attention(q, k, v, causal: bool = True):
-    """Reference attention: fp32 softmax, bf16 matmuls. q:[B,T,H,D] k/v:[B,S,K,D]."""
+def _dot_attention(q, k, v, causal: bool = True, mask: jnp.ndarray | None = None):
+    """Reference attention: fp32 softmax, bf16 matmuls. q:[B,T,H,D] k/v:[B,S,K,D].
+    ``mask`` ([T, S] bool, True = attend) overrides the causal triangle —
+    the decode path uses it to mask unwritten KV-cache slots."""
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
     q = q.reshape(b, t, kh, group, d)
     scores = jnp.einsum("btkgd,bskd->bkgts", q, k).astype(jnp.float32) / jnp.sqrt(d)
-    if causal:
+    if mask is None and causal:
         mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+    if mask is not None:
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
@@ -143,7 +146,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, cache=None, offset=0):
         cfg = self.cfg
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
@@ -153,10 +156,24 @@ class Attention(nn.Module):
         k = dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x)
         v = dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x)
 
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q = apply_rope(q, cos, sin, offset=offset)
+        k = apply_rope(k, cos, sin, offset=offset)
 
-        if cfg.attn_impl == "flash":
+        new_cache = None
+        if cache is not None:
+            # Autoregressive decode: write this call's K/V into the static-
+            # shape cache at ``offset`` and attend over the whole buffer with
+            # the unwritten tail masked out — static shapes keep XLA happy,
+            # O(max_len) work per step is the standard TPU decode trade.
+            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, offset, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, offset, 0, 0))
+            s = k.shape[1]
+            q_pos = offset + jnp.arange(t)[:, None]  # [t, 1]
+            kv_pos = jnp.arange(s)[None, :]  # [1, s]
+            mask = kv_pos <= q_pos  # causal AND only written slots
+            out = _dot_attention(q, k, v, mask=mask)
+            new_cache = {"k": k, "v": v}
+        elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
@@ -173,9 +190,10 @@ class Attention(nn.Module):
             out = _dot_attention(q, k, v, causal=True)
 
         out = out.reshape(b, t, cfg.num_heads * cfg.head_dim)
-        return nn.DenseGeneral(
+        proj = nn.DenseGeneral(
             cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj"
         )(out)
+        return proj if new_cache is None else (proj, new_cache)
 
 
 class MLP(nn.Module):
@@ -197,9 +215,16 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, cache=None, offset=0):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = Attention(cfg, name="attn")(
+                RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset
+            )
+            x = x + attn_out
+        else:
+            x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
         if self.use_moe:
             from .moe import MoEConfig, MoEMLP
 
@@ -214,16 +239,19 @@ class DecoderBlock(nn.Module):
             x = x + MoEMLP(moe_cfg, name="moe")(RMSNorm(name="mlp_norm")(x))
         else:
             x = x + MLP(cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
-        return x
+        return x if new_cache is None else (x, new_cache)
 
 
 class DecoderLM(nn.Module):
-    """Causal LM: tokens [B, T] int32 -> logits [B, T, vocab] fp32."""
+    """Causal LM: tokens [B, T] int32 -> logits [B, T, vocab] fp32.
+
+    With ``cache``/``offset`` (see ``models/generate.py``) runs in
+    autoregressive-decode mode and returns ``(logits, new_cache)``."""
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, cache=None, offset=0):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
@@ -242,9 +270,17 @@ class DecoderLM(nn.Module):
 
         x = constrain(x)
         block_cls = nn.remat(DecoderBlock, prevent_cse=True) if cfg.remat else DecoderBlock
+        new_cache = {} if cache is not None else None
         for i in range(cfg.num_layers):
             use_moe = cfg.num_experts > 0 and cfg.moe_every > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
-            x = constrain(block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x, cos, sin))
+            name = f"layer_{i}"
+            if cache is not None:
+                x, new_cache[name] = DecoderBlock(cfg, use_moe=use_moe, name=name)(
+                    x, cos, sin, cache=cache[name], offset=offset
+                )
+                x = constrain(x)
+            else:
+                x = constrain(block_cls(cfg, use_moe=use_moe, name=name)(x, cos, sin))
 
         x = RMSNorm(name="final_norm")(x)
         if cfg.tie_embeddings:
@@ -254,7 +290,7 @@ class DecoderLM(nn.Module):
             logits = nn.Dense(
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
             )(x)
-        return logits
+        return logits if new_cache is None else (logits, new_cache)
 
 
 def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:

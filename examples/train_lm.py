@@ -56,8 +56,10 @@ class LMStage(dml.TrainValStage):
             **PRESETS[cfg.preset],
         )
         model = DecoderLM(model_cfg)
+        self.model = model  # kept for post-run sampling (--sample)
 
         tokens = synthetic_tokens(cfg.vocab_size, cfg.n_seqs, cfg.seq_len)
+        self.sample_prompt = tokens[:2, :16].copy()
         n_val = max(cfg.batch_size, cfg.n_seqs // 10)
         bs = cfg.batch_size
 
@@ -104,6 +106,10 @@ def main():
     parser.add_argument("--remat", action="store_true", help="recompute blocks in the backward pass (long-context memory)")
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument(
+        "--sample", type=int, default=0, metavar="N",
+        help="after training, greedy-decode N tokens from a corpus prompt (KV-cache generate)",
+    )
     args = parser.parse_args()
 
     init_auto(verbose=True)
@@ -125,8 +131,23 @@ def main():
         pipeline.set_mesh(axes)
     if args.checkpoint_dir:
         pipeline.enable_checkpointing(args.checkpoint_dir)
-    pipeline.append_stage(LMStage(), max_epochs=args.epochs)
+    stage = LMStage()
+    pipeline.append_stage(stage, max_epochs=args.epochs)
     pipeline.run()
+
+    if args.sample > 0:
+        from dmlcloud_tpu.models.generate import generate
+        from dmlcloud_tpu.parallel import runtime
+
+        if runtime.world_size() > 1:
+            # multi-controller decode would need globally-replicated prompt
+            # arrays; the flag is a single-process demo of the decode path
+            if runtime.rank() == 0:
+                print("--sample is a single-process demo; skipping under multi-process runs")
+        else:
+            out = generate(stage.model, stage.state.params, stage.sample_prompt, max_new_tokens=args.sample)
+            for row, cont in zip(stage.sample_prompt.tolist(), np.asarray(out).tolist()):
+                print(f"prompt {row} -> {cont}")
 
 
 if __name__ == "__main__":
