@@ -114,11 +114,19 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[jnp.nda
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0) -> jnp.ndarray:
-    """x: [B, T, H, D]. Rotates pairs (even, odd) of the head dim."""
-    seq_len = x.shape[1]
-    cos = jax.lax.dynamic_slice_in_dim(cos, offset, seq_len)[None, :, None, :]
-    sin = jax.lax.dynamic_slice_in_dim(sin, offset, seq_len)[None, :, None, :]
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int = 0, positions: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """x: [B, T, H, D]. Rotates pairs (even, odd) of the head dim.
+    ``positions`` [B, T] overrides the contiguous ``offset`` window —
+    packed rows use it to restart positions at each segment boundary."""
+    if positions is not None:
+        cos = cos[positions][:, :, None, :]  # [B, T, 1, D/2]
+        sin = sin[positions][:, :, None, :]
+    else:
+        seq_len = x.shape[1]
+        cos = jax.lax.dynamic_slice_in_dim(cos, offset, seq_len)[None, :, None, :]
+        sin = jax.lax.dynamic_slice_in_dim(sin, offset, seq_len)[None, :, None, :]
     x1, x2 = x[..., ::2], x[..., 1::2]
     rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rotated.reshape(x.shape).astype(x.dtype)
@@ -126,8 +134,10 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, offset: int =
 
 def _dot_attention(q, k, v, causal: bool = True, mask: jnp.ndarray | None = None):
     """Reference attention: fp32 softmax, bf16 matmuls. q:[B,T,H,D] k/v:[B,S,K,D].
-    ``mask`` ([T, S] bool, True = attend) overrides the causal triangle —
-    the decode path uses it to mask unwritten KV-cache slots."""
+    ``mask`` ([T, S] or [B, T, S] bool, True = attend) REPLACES the causal
+    triangle entirely — callers must bake causality into it (the decode path
+    does for unwritten KV-cache slots, packed training for segment
+    isolation)."""
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -136,7 +146,9 @@ def _dot_attention(q, k, v, causal: bool = True, mask: jnp.ndarray | None = None
     if mask is None and causal:
         mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
     if mask is not None:
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        if mask.ndim == 2:
+            mask = mask[None]  # [B(1), T, S]
+        scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
     return out.reshape(b, t, h, d)
@@ -146,7 +158,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None):
         cfg = self.cfg
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
@@ -156,11 +168,20 @@ class Attention(nn.Module):
         k = dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x)
         v = dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x)
 
-        q = apply_rope(q, cos, sin, offset=offset)
-        k = apply_rope(k, cos, sin, offset=offset)
+        if seg_info is None:  # packed rows carry per-segment positions instead
+            q = apply_rope(q, cos, sin, offset=offset)
+            k = apply_rope(k, cos, sin, offset=offset)
 
         new_cache = None
-        if cache is not None:
+        if seg_info is not None:
+            # Packed sequences (precomputed once in DecoderLM): rotary
+            # positions restart at each segment's first token and attention
+            # is causal AND same-segment.
+            positions, mask = seg_info
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+            out = _dot_attention(q, k, v, mask=mask)
+        elif cache is not None:
             # Autoregressive decode: write this call's K/V into the static-
             # shape cache at ``offset`` and attend over the whole buffer with
             # the unwritten tail masked out — static shapes keep XLA happy,
@@ -215,7 +236,7 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None):
         cfg = self.cfg
         new_cache = None
         if cache is not None:
@@ -224,7 +245,9 @@ class DecoderBlock(nn.Module):
             )
             x = x + attn_out
         else:
-            x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x), cos, sin)
+            x = x + Attention(cfg, name="attn")(
+                RMSNorm(name="attn_norm")(x), cos, sin, seg_info=seg_info
+            )
         if self.use_moe:
             from .moe import MoEConfig, MoEMLP
 
@@ -246,13 +269,31 @@ class DecoderLM(nn.Module):
     """Causal LM: tokens [B, T] int32 -> logits [B, T, vocab] fp32.
 
     With ``cache``/``offset`` (see ``models/generate.py``) runs in
-    autoregressive-decode mode and returns ``(logits, new_cache)``."""
+    autoregressive-decode mode and returns ``(logits, new_cache)``. With
+    ``segment_ids`` [B, T] int32, rows hold multiple packed examples and
+    attention never crosses segment boundaries (pair with
+    ``lm_loss(..., segment_ids=...)``)."""
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, cache=None, offset=0):
+    def __call__(self, tokens, cache=None, offset=0, segment_ids=None):
         cfg = self.cfg
+        seg_info = None
+        if segment_ids is not None:
+            if cache is not None:
+                raise ValueError("segment_ids are a packed-training feature; unsupported in decode mode")
+            if cfg.attn_impl != "dot":
+                raise ValueError(f"segment_ids require attn_impl='dot' for now, got {cfg.attn_impl!r}")
+            # computed ONCE here, shared by every layer: per-segment rotary
+            # positions (restart at each segment's first token) and the
+            # causal-AND-same-segment attention mask
+            t = tokens.shape[1]
+            same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B, T, S]
+            seg_start = jnp.argmax(same, axis=-1)  # first index of own segment
+            positions = jnp.arange(t)[None, :] - seg_start
+            mask = jnp.tril(jnp.ones((t, t), dtype=bool))[None] & same
+            seg_info = (positions, mask)
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
         )(tokens)
@@ -280,7 +321,9 @@ class DecoderLM(nn.Module):
                 )
                 x = constrain(x)
             else:
-                x = constrain(block_cls(cfg, use_moe=use_moe, name=name)(x, cos, sin))
+                x = constrain(
+                    block_cls(cfg, use_moe=use_moe, name=name)(x, cos, sin, seg_info=seg_info)
+                )
 
         x = RMSNorm(name="final_norm")(x)
         if cfg.tie_embeddings:
@@ -293,10 +336,21 @@ class DecoderLM(nn.Module):
         return logits if new_cache is None else (logits, new_cache)
 
 
-def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Next-token cross entropy over shifted targets."""
+def lm_loss(
+    logits: jnp.ndarray, tokens: jnp.ndarray, segment_ids: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Next-token cross entropy over shifted targets.
+
+    With ``segment_ids`` (packed rows), a position only contributes when its
+    target is in the SAME segment (no predicting across a packing boundary)
+    and the segment is not padding (id 0 marks pad tokens)."""
     import optax
 
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
-    return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if segment_ids is None:
+        return losses.mean()
+    w = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (segment_ids[:, 1:] != 0)
+    w = w.astype(losses.dtype)
+    return (losses * w).sum() / jnp.maximum(w.sum(), 1)
