@@ -280,8 +280,33 @@ def bench_metrics_allreduce(n_procs=8):
         return p50
 
 
+def _init_watchdog(timeout_s: int = 240):
+    """Fail fast when backend init hangs (wedged device tunnel): a daemon
+    thread hard-exits with a clear stderr message unless the returned event
+    is set within ``timeout_s``. Keeps stdout reserved for the JSON line."""
+    import os
+    import sys
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(
+                f"FATAL: jax backend init did not complete within {timeout_s}s (device tunnel down?)",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(2)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done
+
+
 def main():
+    init_ok = _init_watchdog()
     init_auto()
+    jax.devices()  # forces backend init under the watchdog
+    init_ok.set()
     batch = synthetic_batch(np.random.RandomState(0))
     raw_ips = bench_raw(batch)
     fw_ips = bench_framework(batch)
