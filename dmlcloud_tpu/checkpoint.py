@@ -46,6 +46,26 @@ def is_remote_path(path: Any) -> bool:
     return "://" in os.fspath(path)
 
 
+def _normalize_opt(v: Any) -> Any:
+    """Structural key for an Orbax option value, comparable across calls.
+    Callables (e.g. a ``BestN.get_metric_fn`` lambda rebuilt per call) map to
+    their qualname and dataclass policies to their field structure, so
+    re-specifying an identical configuration is idempotent instead of
+    tripping the changed-options guard on lambda identity."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (
+            type(v).__name__,
+            tuple((f.name, _normalize_opt(getattr(v, f.name))) for f in dataclasses.fields(v)),
+        )
+    if callable(v):
+        return getattr(v, "__qualname__", repr(type(v)))
+    if isinstance(v, (list, tuple)):
+        return tuple(_normalize_opt(x) for x in v)
+    return v
+
+
 def atomic_write_text(target: epath.Path, text: str) -> None:
     """Crash-safe small-file write. Local filesystems get tmp-file +
     ``os.replace``; object stores commit whole objects atomically already,
@@ -175,6 +195,11 @@ class CheckpointDir:
         return Config.load(self.config_file)
 
     # -- tensor state via Orbax (new capability vs reference) ---------------
+    def has_state_manager(self, scope: str | None = None) -> bool:
+        """Whether an Orbax manager for ``scope`` was already created (and
+        its options therefore already bound)."""
+        return scope in self._state_managers
+
     def state_manager(
         self, scope: str | None = None, max_to_keep: int | None = None, async_save: bool | None = None, **options
     ):
@@ -188,10 +213,13 @@ class CheckpointDir:
         FIRST creation per scope (e.g. in ``pre_stage``); explicitly passing
         different options for an existing scope raises."""
         explicit = max_to_keep is not None or async_save is not None or bool(options)
+        # a preservation_policy owns retention outright — orbax rejects it
+        # combined with max_to_keep, so the default only applies without one
+        default_keep = None if "preservation_policy" in options else 3
         requested = (
-            3 if max_to_keep is None else max_to_keep,
+            default_keep if max_to_keep is None else max_to_keep,
             True if async_save is None else async_save,
-            tuple(sorted(options.items())),
+            tuple(sorted((k, _normalize_opt(v)) for k, v in options.items())),
         )
         if scope in self._state_managers:
             cached = self._manager_opts[scope]

@@ -319,6 +319,22 @@ class TrainValStage(Stage):
         extras, metric histories, and the epoch counter are all restored."""
         return 1
 
+    def checkpoint_keep(self) -> int:
+        """How many checkpoints the stage's Orbax manager retains."""
+        return 3
+
+    def checkpoint_best_metric(self) -> str | None:
+        """Tracker metric (e.g. ``'val/loss'``) ranking which checkpoints to
+        KEEP: retention holds the best ``checkpoint_keep()`` by this metric
+        instead of the most recent. None (default) keeps most-recent.
+        Orbax additionally always preserves the newest checkpoint, so a
+        Slurm-requeue resume continues from the latest epoch either way."""
+        return None
+
+    def checkpoint_best_mode(self) -> str:
+        """'min' (e.g. losses) or 'max' (e.g. accuracies)."""
+        return "min"
+
     # -- state construction -------------------------------------------------
     def make_state(self) -> TrainState:
         """Build the TrainState from the pipeline registries. Override for
@@ -469,12 +485,51 @@ class TrainValStage(Stage):
         return jax.jit(val_step)
 
     # -- lifecycle ----------------------------------------------------------
+    def _configure_state_manager(self):
+        """Bind this stage's Orbax retention options (keep count, optional
+        keep-best ranking) at first manager creation — before any
+        save/restore touches the scope."""
+        ckpt = self.pipeline.checkpoint_dir
+        if ckpt is None or int(self.checkpoint_every()) <= 0:
+            return
+        if ckpt.has_state_manager(self.name):
+            return  # the user configured this scope in pre_stage; their options win
+        opts = {}
+        metric = self.checkpoint_best_metric()
+        if metric is not None:
+            mode = self.checkpoint_best_mode()
+            if mode not in ("min", "max"):
+                raise ValueError(f"checkpoint_best_mode() must be 'min' or 'max', got {mode!r}")
+            from orbax.checkpoint import checkpoint_managers as ocm
+
+            # best-N by the metric PLUS always the newest (deterministic
+            # requeue-resume freshness; best_fn+max_to_keep alone leaves the
+            # latest checkpoint's survival to async-gc timing)
+            opts = {
+                "preservation_policy": ocm.AnyPreservationPolicy(
+                    [
+                        ocm.LatestN(n=1),
+                        ocm.BestN(
+                            get_metric_fn=lambda m: m[metric],
+                            reverse=(mode == "min"),
+                            n=int(self.checkpoint_keep()),
+                            # metricless saves must not accumulate forever;
+                            # LatestN above still protects the newest one
+                            keep_checkpoints_without_metrics=False,
+                        ),
+                    ]
+                )
+            }
+        keep = None if opts else int(self.checkpoint_keep())  # policy owns retention when set
+        ckpt.state_manager(self.name, max_to_keep=keep, **opts)
+
     def _pre_stage(self):
         super()._pre_stage()
         if self.state is None:
             entry = self.pipeline._model_entry(self.model_name())
             self._policy = entry.policy
             self.state = self.make_state()
+        self._configure_state_manager()
         if self.pipeline.resumed and int(self.checkpoint_every()) > 0:
             # manual mode (checkpoint_every()==0) owns its restore layout too
             self._restore_state()
@@ -515,7 +570,19 @@ class TrainValStage(Stage):
         final = completed == self.max_epochs or self._stop_requested
         if completed % every != 0 and not final:
             return
-        ckpt.save_state(completed, self._state_pytree(), scope=self.name)
+        save_kwargs = {}
+        best_metric = self.checkpoint_best_metric()
+        if best_metric is not None:
+            hist = self.tracker[best_metric] if best_metric in self.tracker else []
+            val = hist[-1] if hist else None
+            if val is None:
+                self.logger.warning(
+                    f"checkpoint_best_metric {best_metric!r} has no value for epoch "
+                    f"{completed}; this save is unranked (retained only while it is the newest)"
+                )
+            else:
+                save_kwargs["metrics"] = {best_metric: float(val)}
+        ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
         if is_root():
             import json
 

@@ -250,3 +250,96 @@ def test_checkpoint_every_zero_disables_state_saves(tmp_path, single_runtime):
     state_dir = pipeline.checkpoint_dir.state_dir / "TrainValStage"
     assert not state_dir.exists() or not any(state_dir.iterdir())
     pipeline.checkpoint_dir.close()
+
+
+class _BestStage(_ToyStage):
+    """Tracks a controlled non-monotonic 'score' so keep-best retention is
+    distinguishable from keep-most-recent."""
+
+    PATTERN = [1.0, 5.0, 2.0, 4.0, 3.0]
+
+    def pre_epoch(self):
+        self.track_reduce("score", self.PATTERN[self.current_epoch - 1], prefixed=False)
+
+    def checkpoint_best_metric(self):
+        return "score"
+
+    def checkpoint_best_mode(self):
+        return "max"
+
+    def checkpoint_keep(self):
+        return 2
+
+
+def test_keep_best_retention(tmp_path, single_runtime):
+    pipeline = dml.TrainingPipeline(name="best")
+    stage = _BestStage()
+    pipeline.append_stage(stage, max_epochs=5, name="TrainValStage")
+    pipeline.enable_checkpointing(str(tmp_path))
+    pipeline.run()
+    run_dir = str(pipeline.checkpoint_dir)
+    pipeline.checkpoint_dir.close()
+
+    # retention kept the two highest-scoring epochs (2: 5.0, 4: 4.0) plus the
+    # newest (5 — Orbax always preserves the latest so requeue resume stays
+    # fresh), and dropped epochs 1 and 3
+    from dmlcloud_tpu.checkpoint import CheckpointDir
+
+    ckpt = CheckpointDir(run_dir)
+    assert sorted(ckpt.state_manager("TrainValStage").all_steps()) == [2, 4, 5]
+    # resume sidecars stayed in lockstep with the kept steps
+    metas = sorted(int(f.stem) for f in (ckpt.path / "meta" / "TrainValStage").glob("*.json"))
+    assert metas == [2, 4, 5]
+    ckpt.close()
+
+
+def test_keep_best_invalid_mode_rejected(tmp_path, single_runtime):
+    class BadMode(_BestStage):
+        def checkpoint_best_mode(self):
+            return "most"
+
+    pipeline = dml.TrainingPipeline(name="badmode")
+    pipeline.append_stage(BadMode(), max_epochs=1, name="TrainValStage")
+    pipeline.enable_checkpointing(str(tmp_path))
+    with pytest.raises(ValueError, match="checkpoint_best_mode"):
+        pipeline.run()
+
+
+def test_user_configured_manager_in_pre_stage_wins(tmp_path, single_runtime):
+    """The documented pattern — binding scope options via state_manager(...)
+    in pre_stage — must not collide with the stage's automatic retention
+    config."""
+
+    class UserCfg(_ToyStage):
+        def pre_stage(self):
+            super().pre_stage()
+            self.pipeline.checkpoint_dir.state_manager("TrainValStage", max_to_keep=10)
+
+    pipeline = dml.TrainingPipeline(name="usercfg")
+    pipeline.append_stage(UserCfg(), max_epochs=2, name="TrainValStage")
+    pipeline.enable_checkpointing(str(tmp_path))
+    pipeline.run()  # would raise RuntimeError if the stage re-bound options
+    assert pipeline.checkpoint_dir._manager_opts["TrainValStage"][0] == 10
+
+
+def test_identical_policy_respecification_is_idempotent(tmp_path, single_runtime):
+    """Re-specifying a byte-identical keep-best policy (fresh lambdas) must
+    not trip the changed-options guard."""
+    from orbax.checkpoint import checkpoint_managers as ocm
+
+    from dmlcloud_tpu.checkpoint import CheckpointDir
+
+    ckpt = CheckpointDir(str(tmp_path / "run"))
+    ckpt.create()
+
+    def policy():
+        return ocm.AnyPreservationPolicy(
+            [ocm.LatestN(n=1), ocm.BestN(get_metric_fn=lambda m: m["s"], n=2)]
+        )
+
+    m1 = ckpt.state_manager("s", preservation_policy=policy())
+    m2 = ckpt.state_manager("s", preservation_policy=policy())  # same config, new lambdas
+    assert m1 is m2
+    with pytest.raises(RuntimeError, match="already exists"):
+        ckpt.state_manager("s", preservation_policy=ocm.AnyPreservationPolicy([ocm.LatestN(n=5)]))
+    ckpt.close()
