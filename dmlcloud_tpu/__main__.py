@@ -1,0 +1,42 @@
+"""``python -m dmlcloud_tpu`` — environment / topology diagnostics CLI.
+
+Prints the same reproducibility block a TrainingPipeline logs at run start
+(versions, git state, accelerator topology, Slurm env), without starting a
+run — the first thing to ask for when a cluster job misbehaves. The
+reference has no CLI; its equivalent is buried in run logs
+(util/logging.py:131-173).
+
+    python -m dmlcloud_tpu              # full diagnostics
+    python -m dmlcloud_tpu --json      # machine-readable subset
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlcloud_tpu", description="Print environment/topology diagnostics."
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable subset")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from . import __version__
+    from .utils.logging import accelerator_info, general_diagnostics
+
+    if not args.json:
+        print(f"dmlcloud_tpu {__version__}")
+        print(general_diagnostics())
+        return 0
+
+    info = {"version": __version__, "python": sys.version.split()[0], "jax": jax.__version__}
+    info.update(accelerator_info())  # {"error": ...} when backend init fails
+    print(json.dumps(info))
+    return 1 if "error" in info else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

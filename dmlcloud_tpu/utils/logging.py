@@ -191,6 +191,31 @@ def experiment_header(name: str | None, checkpoint_path: str | None, start_time)
     return "\n".join(lines)
 
 
+def accelerator_info() -> dict:
+    """Structured accelerator probe — ONE source for the text diagnostics
+    block and the ``python -m dmlcloud_tpu --json`` CLI. Returns
+    ``{"error": ...}`` instead of raising when backend init fails
+    (diagnostics must never kill a run — or the CLI that debugs one)."""
+    try:
+        devices = jax.devices()
+        kinds = sorted({d.device_kind for d in devices})
+        info = {
+            "backend": jax.default_backend(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "global_devices": len(devices),
+            "local_devices": jax.local_device_count(),
+            "device_kinds": kinds,
+            "device_kind_counts": {k: sum(1 for d in devices if d.device_kind == k) for k in kinds},
+        }
+        coords = getattr(devices[0], "coords", None)
+        if coords is not None:
+            info["device0_coords"] = list(coords)
+        return info
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def general_diagnostics() -> str:
     """The reproducibility block logged at run start (reference
     util/logging.py:131-173) — argv, cwd, host, user, git state, Python env,
@@ -218,20 +243,17 @@ def general_diagnostics() -> str:
     lines.append(f"    - python: {sys.version.split()[0]}")
 
     lines.append("* ACCELERATORS:")
-    try:
-        devices = jax.devices()
-        lines.append(f"    - backend: {jax.default_backend()}")
-        lines.append(f"    - process: {jax.process_index()}/{jax.process_count()}")
-        lines.append(f"    - devices: {len(devices)} global, {jax.local_device_count()} local")
-        kinds = sorted({d.device_kind for d in devices})
-        for kind in kinds:
-            n = sum(1 for d in devices if d.device_kind == kind)
+    acc = accelerator_info()
+    if "error" in acc:
+        lines.append(f"    - <error probing devices: {acc['error']}>")
+    else:
+        lines.append(f"    - backend: {acc['backend']}")
+        lines.append(f"    - process: {acc['process_index']}/{acc['process_count']}")
+        lines.append(f"    - devices: {acc['global_devices']} global, {acc['local_devices']} local")
+        for kind, n in acc["device_kind_counts"].items():
             lines.append(f"    - {n}x {kind}")
-        coords = getattr(devices[0], "coords", None)
-        if coords is not None:
-            lines.append(f"    - device 0 coords: {coords}")
-    except Exception as e:  # diagnostics must never kill a run
-        lines.append(f"    - <error probing devices: {e}>")
+        if "device0_coords" in acc:
+            lines.append(f"    - device 0 coords: {acc['device0_coords']}")
 
     lines.append("* VERSIONS:")
     for mod in ML_MODULES:

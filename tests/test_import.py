@@ -15,3 +15,24 @@ def test_version():
 def test_public_api():
     for sym in ("TrainingPipeline", "Stage", "TrainValStage", "MetricTracker", "Reduction", "CheckpointDir"):
         assert hasattr(dmlcloud_tpu, sym)
+
+
+def test_cli_diagnostics_json(capsys):
+    """python -m dmlcloud_tpu --json prints one machine-readable line."""
+    import json
+
+    from dmlcloud_tpu.__main__ import main
+
+    assert main(["--json"]) == 0
+    out = capsys.readouterr().out.strip()
+    info = json.loads(out)
+    assert info["global_devices"] >= 1
+    assert "jax" in info and "version" in info
+
+
+def test_cli_diagnostics_text(capsys):
+    from dmlcloud_tpu.__main__ import main
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "* ACCELERATORS:" in out and "* VERSIONS:" in out
