@@ -40,6 +40,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     attn_impl: str = "dot"  # 'dot' | 'flash' | 'ring'
+    # Sliding-window attention (Mistral convention): each token attends to
+    # itself + the previous W-1. Supported by 'dot' and 'flash' (where stale
+    # K/V blocks are skipped — O(T*W) compute), and by the decode cache.
+    sliding_window: int | None = None
     # MoE: replace the dense MLP with an expert-parallel MoEMLP (models/moe.py)
     # in every ``moe_every``-th block (0 = dense everywhere). Experts shard
     # over the ``expert`` mesh axis via moe_partition_rules().
@@ -68,6 +72,11 @@ class TransformerConfig:
         if self.attn_impl not in ("dot", "flash", "ring"):
             # a typo here would otherwise silently run the unfused path
             raise ValueError(f"attn_impl must be 'dot', 'flash' or 'ring', got {self.attn_impl!r}")
+        if self.sliding_window is not None:
+            if self.sliding_window < 1:
+                raise ValueError(f"sliding_window must be >= 1, got {self.sliding_window}")
+            if self.attn_impl == "ring":
+                raise ValueError("sliding_window is not supported with attn_impl='ring'")
 
     @property
     def kv_heads(self) -> int:
@@ -132,6 +141,13 @@ def apply_rope(
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
+def _window_keep(q_pos, k_pos, window: int) -> jnp.ndarray:
+    """The sliding-window predicate, defined ONCE (Mistral convention:
+    attend to self + the previous window-1 → ``q_pos - k_pos < window``).
+    Broadcasts over whatever position shapes the caller derived."""
+    return (q_pos - k_pos) < window
+
+
 def _dot_attention(q, k, v, causal: bool = True, mask: jnp.ndarray | None = None):
     """Reference attention: fp32 softmax, bf16 matmuls. q:[B,T,H,D] k/v:[B,S,K,D].
     ``mask`` ([T, S] or [B, T, S] bool, True = attend) REPLACES the causal
@@ -192,12 +208,14 @@ class Attention(nn.Module):
             q_pos = offset + jnp.arange(t)[:, None]  # [t, 1]
             kv_pos = jnp.arange(s)[None, :]  # [1, s]
             mask = kv_pos <= q_pos  # causal AND only written slots
+            if cfg.sliding_window is not None:
+                mask = mask & _window_keep(q_pos, kv_pos, cfg.sliding_window)
             out = _dot_attention(q, k, v, mask=mask)
             new_cache = {"k": k, "v": v}
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True, window=cfg.sliding_window)
         elif cfg.attn_impl == "ring":
             if cfg.mesh is not None:
                 from ..ops.ring_attention import ring_attention_sharded
@@ -207,6 +225,12 @@ class Attention(nn.Module):
                 from ..ops.ring_attention import ring_attention
 
                 out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
+        elif cfg.sliding_window is not None:
+            pos = jnp.arange(t)
+            q_pos, k_pos = pos[:, None], pos[None, :]
+            out = _dot_attention(
+                q, k, v, mask=(q_pos >= k_pos) & _window_keep(q_pos, k_pos, cfg.sliding_window)
+            )
         else:
             out = _dot_attention(q, k, v, causal=True)
 
@@ -293,6 +317,9 @@ class DecoderLM(nn.Module):
             seg_start = jnp.argmax(same, axis=-1)  # first index of own segment
             positions = jnp.arange(t)[None, :] - seg_start
             mask = jnp.tril(jnp.ones((t, t), dtype=bool))[None] & same
+            if cfg.sliding_window is not None:
+                pos = jnp.arange(t)
+                mask = mask & _window_keep(pos[:, None], pos[None, :], cfg.sliding_window)[None]
             seg_info = (positions, mask)
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"

@@ -58,8 +58,24 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+def _window_mask(s, q0, k0, q_block, block_k, causal: bool, window: int | None):
+    """Apply causal (and optional sliding-window) masking to a [bq, bk] score
+    block whose top-left element is (q0, k0). ``window`` = W keeps
+    ``q_pos - k_pos < W`` (self + W-1 predecessors), the Mistral convention."""
+    if not causal and window is None:
+        return s
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
+    keep = q_pos >= k_pos if causal else None
+    if window is not None:
+        wkeep = (q_pos - k_pos) < window
+        keep = wkeep if keep is None else keep & wkeep
+    return jnp.where(keep, s, _NEG_INF)
+
+
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, *rest, block_k: int, causal: bool, sm_scale: float, q_block: int, num_kb: int
+    q_ref, k_ref, v_ref, o_ref, *rest, block_k: int, causal: bool, sm_scale: float, q_block: int,
+    num_kb: int, window: int | None
 ):
     # Grid (B*H, T/block_q, S/block_k) — K/V STREAM through the innermost
     # grid axis, so VMEM holds one [block_k, D] tile of each at a time (plus
@@ -93,10 +109,7 @@ def _attn_kernel(
             jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
             * sm_scale
         )  # [bq, bk] fp32
-        if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _window_mask(s, qi * q_block, kb * block_k, q_block, block_k, causal, window)
         m_prev = m_ref[:, :1]  # [bq, 1]
         l_prev = l_ref[:, :1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -111,8 +124,12 @@ def _attn_kernel(
         acc_ref[...] = acc_ref[...] * correction + pv
 
     if causal:
-        # K blocks fully past the diagonal contribute nothing — skip them
-        pl.when(kb * block_k <= qi * q_block + q_block - 1)(_accumulate)
+        # K blocks fully past the diagonal contribute nothing — skip them;
+        # with a sliding window, so do blocks entirely older than the window
+        cond = kb * block_k <= qi * q_block + q_block - 1
+        if window is not None:
+            cond &= kb * block_k + block_k - 1 >= qi * q_block - window + 1
+        pl.when(cond)(_accumulate)
     else:
         _accumulate()
 
@@ -125,7 +142,7 @@ def _attn_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, block_k: int, causal: bool, sm_scale: float, q_block: int, num_kb: int
+    *, block_k: int, causal: bool, sm_scale: float, q_block: int, num_kb: int, window: int | None
 ):
     # Grid (B*H, T/block_q, S/block_k): K/V stream through the innermost grid
     # axis (same VMEM-bounded layout as the forward); dq accumulates in fp32
@@ -149,10 +166,7 @@ def _dq_kernel(
             jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
             * sm_scale
         )  # [bq, bk]
-        if causal:
-            q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (q_block, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _window_mask(s, qi * q_block, kb * block_k, q_block, block_k, causal, window)
         p = jnp.exp(s - lse)  # [bq, bk] fp32; masked entries underflow to 0
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
@@ -161,7 +175,10 @@ def _dq_kernel(
         )
 
     if causal:
-        pl.when(kb * block_k <= qi * q_block + q_block - 1)(_accumulate)
+        cond = kb * block_k <= qi * q_block + q_block - 1
+        if window is not None:
+            cond &= kb * block_k + block_k - 1 >= qi * q_block - window + 1
+        pl.when(cond)(_accumulate)
     else:
         _accumulate()
 
@@ -171,7 +188,8 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q: int, causal: bool, sm_scale: float, k_block: int
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, causal: bool, sm_scale: float, k_block: int, window: int | None
 ):
     # grid (B*H, S/block_k, T/block_q): one KV block accumulates across the
     # innermost q-block dimension (dk/dv output blocks are revisited — they
@@ -196,10 +214,7 @@ def _dkv_kernel(
             jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
             * sm_scale
         )  # [bq, bk]
-        if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, k_block), 0)
-            k_pos = kb * k_block + jax.lax.broadcasted_iota(jnp.int32, (block_q, k_block), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _window_mask(s, qb * block_q, kb * k_block, block_q, k_block, causal, window)
         p = jnp.exp(s - lse)  # [bq, bk] fp32
         dv_ref[0] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -211,8 +226,12 @@ def _dkv_kernel(
         ).astype(dk_ref.dtype)
 
     if causal:
-        # skip q blocks entirely above the diagonal (their p is all zero)
-        pl.when((qb + 1) * block_q - 1 >= kb * k_block)(_accumulate)
+        # skip q blocks entirely above the diagonal (their p is all zero);
+        # with a sliding window, also q blocks entirely past k_last + window
+        cond = (qb + 1) * block_q - 1 >= kb * k_block
+        if window is not None:
+            cond &= qb * block_q <= kb * k_block + k_block + window - 2
+        pl.when(cond)(_accumulate)
     else:
         _accumulate()
 
@@ -232,15 +251,18 @@ def _auto_block(requested: int, seq: int) -> int:
     return blk
 
 
-def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+def _reference_attention(q, k, v, causal: bool, sm_scale: float, window: int | None = None):
     """Unfused GQA attention (fp32 softmax) — the numerical reference for tests."""
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
     qg = q.reshape(b, t, kh, group, d)
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * sm_scale
-    if causal:
-        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+    if causal or window is not None:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t) if causal else jnp.ones((t, s), bool)
+        if window is not None:
+            dist = jnp.arange(t)[:, None] - jnp.arange(s)[None, :] + (s - t)
+            mask = mask & (dist < window)
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
@@ -257,8 +279,15 @@ def flash_attention(
     block_k: int = 1024,
     interpret: bool | None = None,
     return_lse: bool = False,
+    window: int | None = None,
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0. Returns [B, T, H, D].
+
+    ``window`` = W enables sliding-window attention (requires ``causal``):
+    each query attends to itself and its W-1 predecessors
+    (``q_pos - k_pos < W``, the Mistral convention). K/V blocks entirely
+    older than the window are skipped in the grid AND their DMAs elided, so
+    compute and HBM traffic scale with O(T·W) instead of O(T²).
 
     Sequence lengths must be multiples of the block sizes (pad upstream);
     block sizes auto-shrink for short sequences. Differentiable end-to-end in
@@ -289,54 +318,63 @@ def flash_attention(
         raise ValueError(
             f"causal flash attention requires equal Q/KV sequence lengths, got {t} != {k.shape[1]}"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
     bq, bk = _auto_block(block_q, t), _auto_block(block_k, k.shape[1])
     if return_lse:
-        out, lse = _flash_lse(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret))
+        out, lse = _flash_lse(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret), window)
         return out, lse.reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
-    return _flash(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret))
+    return _flash(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret), window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, window)
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
     out, lse = _flash_fwd_impl(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
+    return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
     """(out, lse[B*H, T]) variant for blockwise/ring combiners."""
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True)
+    return _flash_fwd_impl(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
+    )
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
     out, lse = _flash_fwd_impl(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=True
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, gs):
+def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, residuals, gs):
     g_out, g_lse = gs
     q, k, v, out, lse = residuals
     # d lse_i / d s_ij = p_ij, so the lse cotangent enters the existing
     # backward as ds += p * g_lse — algebraically a shift of the delta term:
     # ds = p * (dp - (delta - g_lse)). Zero kernel changes needed.
     return _flash_bwd_impl(
-        q, k, v, out, lse, g_out, causal, sm_scale, block_q, block_k, interpret, lse_cotangent=g_lse
+        q, k, v, out, lse, g_out, causal, sm_scale, block_q, block_k, interpret, window,
+        lse_cotangent=g_lse,
     )
 
 
@@ -361,27 +399,36 @@ def _make_kv_index(h: int, kh: int):
     return kv_index
 
 
-def _clamp_kv_stream(kb, qi, block_q: int, block_k: int, causal: bool):
+def _clamp_kv_stream(kb, qi, block_q: int, block_k: int, causal: bool, window: int | None = None):
     """Clamp the streamed K-block index under causal masking so fully skipped
-    grid steps (past the diagonal) re-request the previous block index —
-    Mosaic elides the DMA when consecutive steps map to the same block,
-    saving the ~2x K/V HBM traffic that `pl.when` alone would still copy
-    and discard."""
+    grid steps (past the diagonal — and, with a sliding window, older than
+    the window) re-request an adjacent participating block index — Mosaic
+    elides the DMA when consecutive steps map to the same block, saving the
+    K/V HBM traffic that `pl.when` alone would still copy and discard."""
     if not causal:
         return kb
-    return jnp.minimum(kb, ((qi + 1) * block_q - 1) // block_k)
+    hi = ((qi + 1) * block_q - 1) // block_k
+    if window is not None:
+        lo = jnp.maximum(qi * block_q - window + 1, 0) // block_k
+        return jnp.clip(kb, lo, hi)
+    return jnp.minimum(kb, hi)
 
 
-def _clamp_q_stream(qb, kb, block_q: int, block_k: int, causal: bool):
+def _clamp_q_stream(qb, kb, block_q: int, block_k: int, causal: bool, window: int | None = None):
     """Same trick for the dK/dV kernel's streamed Q axis: Q blocks entirely
-    above the diagonal for this KV block are clamped to the first one that
-    participates."""
+    above the diagonal (or, with a sliding window, entirely past
+    k_last + window) for this KV block are clamped to an adjacent
+    participating block."""
     if not causal:
         return qb
-    return jnp.maximum(qb, (kb * block_k) // block_q)
+    lo = (kb * block_k) // block_q
+    if window is not None:
+        hi = (kb * block_k + block_k - 1 + window - 1) // block_q
+        return jnp.clip(qb, lo, hi)
+    return jnp.maximum(qb, lo)
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with_residuals=False):
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, window=None, with_residuals=False):
     if _VMEM is None:
         raise RuntimeError(
             "flash_attention needs jax.experimental.pallas.tpu (VMEM scratch accumulators); "
@@ -401,12 +448,13 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with
     num_kb = s // block_k
 
     kernel = functools.partial(
-        _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q, num_kb=num_kb
+        _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q,
+        num_kb=num_kb, window=window,
     )
     vmem = {"memory_space": _VMEM}
 
     def kv_block(bh, qi, kb):
-        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal), 0)
+        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window), 0)
 
     out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem)]
@@ -439,7 +487,9 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, with
     return out
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, lse_cotangent=None):
+def _flash_bwd_impl(
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window=None, lse_cotangent=None
+):
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -462,12 +512,13 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, in
     vmem = {"memory_space": _VMEM}
 
     def kv_block(bh, qi, kb):
-        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal), 0)
+        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window), 0)
 
     num_kb = s // block_k
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q, num_kb=num_kb
+            _dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q,
+            num_kb=num_kb, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         grid=(b * h, t // block_q, num_kb),
@@ -487,10 +538,12 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, in
     # per-query-head dK/dV; group-summed below for GQA. 3D grid: the q-block
     # axis is innermost so dk/dv output blocks accumulate in VMEM.
     def q_stream(qb, kb):
-        return _clamp_q_stream(qb, kb, block_q, block_k, causal)
+        return _clamp_q_stream(qb, kb, block_q, block_k, causal, window)
 
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale, k_block=block_k),
+        functools.partial(
+            _dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale, k_block=block_k, window=window
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),

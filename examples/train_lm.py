@@ -50,6 +50,7 @@ class LMStage(dml.TrainValStage):
             max_seq_len=cfg.seq_len,
             attn_impl=cfg.attn,
             remat=bool(cfg.get("remat", False)),
+            sliding_window=cfg.get("window"),
             # ring attention under plain jit needs the mesh to shard_map
             # itself over the seq axis; dot/flash are mesh-agnostic
             mesh=self.mesh if cfg.attn == "ring" else None,
@@ -103,6 +104,7 @@ def main():
     parser.add_argument("--n-seqs", type=int, default=512)
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--attn", choices=["dot", "flash", "ring"], default="dot")
+    parser.add_argument("--window", type=int, default=None, help="sliding-window attention width")
     parser.add_argument("--remat", action="store_true", help="recompute blocks in the backward pass (long-context memory)")
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
@@ -123,6 +125,7 @@ def main():
         "lr": args.lr,
         "attn": args.attn,
         "remat": args.remat,
+        "window": args.window,
         "seed": 0,
     }
     pipeline = dml.TrainingPipeline(config, name=f"lm-{args.preset}")

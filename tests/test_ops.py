@@ -113,6 +113,48 @@ class TestFlashAttention:
                 np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
             )
 
+    @pytest.mark.parametrize("window", [1, 17, 32, 100, 128])
+    def test_sliding_window_matches_reference(self, window):
+        """Window values spanning sub-block, block-multiple, and full-seq —
+        exercises the stale-block skip and both mask boundaries."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        q, k, v = _qkv(t=128, h=2, d=16, seed=5)
+        expected = _reference_attention(q, k, v, True, 1.0 / np.sqrt(16), window=window)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("window", [24, 64])
+    def test_sliding_window_backward_matches_reference(self, window):
+        """Windowed backward in both kernels (dq stale-block skip; dkv
+        past-window skip), with uneven blocks."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        q, k, v = _qkv(t=128, h=4, kh=2, d=16, seed=6)
+        cot = jnp.asarray(np.random.RandomState(9).randn(*q.shape), q.dtype)
+
+        def flash_loss(q, k, v):
+            return jnp.vdot(
+                flash_attention(q, k, v, causal=True, block_q=64, block_k=32, window=window), cot
+            )
+
+        def ref_loss(q, k, v):
+            return jnp.vdot(_reference_attention(q, k, v, True, 1.0 / np.sqrt(16), window=window), cot)
+
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_sliding_window_requires_causal(self):
+        q, k, v = _qkv(t=64, h=2, d=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=16)
+        with pytest.raises(ValueError, match=">= 1"):
+            flash_attention(q, k, v, causal=True, window=0)
+
     def test_backward_uneven_qk_blocks(self):
         """block_q != block_k exercises the diagonal-skip bounds in both
         backward kernels (dq upper bound, dkv lower bound)."""

@@ -91,3 +91,56 @@ def test_gradients_flow_through_packed_path(packed_setup):
     grads = jax.grad(loss_fn)(params)
     gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree_util.tree_leaves(grads))
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+class TestSlidingWindow:
+    """cfg.sliding_window across the model's attention paths."""
+
+    def test_dot_vs_flash_windowed(self):
+        cfg_dot = _cfg(sliding_window=7, max_seq_len=64)
+        cfg_flash = _cfg(sliding_window=7, max_seq_len=64, attn_impl="flash")
+        model_dot, model_flash = DecoderLM(cfg_dot), DecoderLM(cfg_flash)
+        rng = np.random.RandomState(3)
+        toks = jnp.asarray(rng.randint(0, 37, size=(2, 64)), jnp.int32)
+        params = model_dot.init(jax.random.PRNGKey(0), toks)["params"]
+        out_dot = model_dot.apply({"params": params}, toks)
+        out_flash = model_flash.apply({"params": params}, toks)
+        np.testing.assert_allclose(np.asarray(out_dot), np.asarray(out_flash), atol=2e-4, rtol=2e-4)
+
+    def test_windowed_decode_matches_no_cache(self):
+        from dmlcloud_tpu.models.generate import generate
+
+        cfg = _cfg(sliding_window=5, max_seq_len=32)
+        model = DecoderLM(cfg)
+        rng = np.random.RandomState(4)
+        prompt = jnp.asarray(rng.randint(0, 37, size=(2, 9)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+
+        tokens = prompt
+        want = []
+        for _ in range(6):
+            logits = model.apply({"params": params}, tokens)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            want.append(nxt)
+            tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+        got = generate(model, params, prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(jnp.stack(want, axis=1)))
+
+    def test_windowed_packed_matches_unpacked(self):
+        cfg = _cfg(sliding_window=3)
+        model = DecoderLM(cfg)
+        rng = np.random.RandomState(5)
+        a = rng.randint(1, 37, size=6)
+        b = rng.randint(1, 37, size=5)
+        row = np.concatenate([a, b])[None]
+        segs = np.asarray([1] * 6 + [2] * 5)[None]
+        params = model.init(jax.random.PRNGKey(2), jnp.asarray(row))["params"]
+        packed = model.apply({"params": params}, jnp.asarray(row), segment_ids=jnp.asarray(segs))
+        la = model.apply({"params": params}, jnp.asarray(a[None]))
+        lb = model.apply({"params": params}, jnp.asarray(b[None]))
+        np.testing.assert_allclose(np.asarray(packed[0, :6]), np.asarray(la[0]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(packed[0, 6:]), np.asarray(lb[0]), atol=1e-5)
+
+    def test_ring_rejects_window(self):
+        with pytest.raises(ValueError, match="ring"):
+            _cfg(sliding_window=8, attn_impl="ring")
