@@ -7,6 +7,7 @@ from .datasets import (
     ShardedXrDataset,
     interleave_batches,
     interleave_dict_batches,
+    pack_sequences,
     sharded_xr_dataset,
 )
 from .device import device_iterator
@@ -21,6 +22,7 @@ __all__ = [
     "ShardedXrDataset",
     "interleave_batches",
     "interleave_dict_batches",
+    "pack_sequences",
     "sharded_xr_dataset",
     "device_iterator",
     "chunk_and_shard_indices",

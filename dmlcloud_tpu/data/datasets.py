@@ -520,3 +520,73 @@ class BatchDataset(DownstreamDataset):
         batched = DataPipeline(self._make_iter, self._length_fn).batch(batch_size, drop_remainder)
         self._make_iter = batched._make_iter
         self._length_fn = batched._length_fn
+
+
+def pack_sequences(
+    examples: Iterable[Sequence[int] | np.ndarray],
+    seq_len: int,
+    *,
+    split_long: bool = True,
+) -> Iterator[dict]:
+    """Greedily pack variable-length token sequences into fixed ``seq_len``
+    rows, yielding ``{"tokens": [seq_len] int32, "segment_ids": [seq_len]
+    int32}`` — the input contract of ``DecoderLM(segment_ids=...)`` /
+    ``lm_loss(segment_ids=...)`` (models/transformer.py): segment ids are
+    1-based per row, 0 marks padding, attention never crosses a segment
+    boundary and positions restart per segment.
+
+    Streaming single-pass fill: an example that fits the remaining row space
+    is appended whole; one that fits an EMPTY row starts a fresh row (never
+    split — a split would sever intra-example attention and break the
+    packed-equals-unpacked equivalence); only examples longer than
+    ``seq_len`` itself are split across rows when ``split_long`` (each part
+    its own segment — no cross-row attention), else truncated to
+    ``seq_len``. The trailing partially-filled row is emitted padded. (The
+    reference has no packing; this is TPU-side scope — static shapes
+    without burning FLOPs on padding.)
+    """
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    tokens = np.zeros(seq_len, np.int32)
+    segs = np.zeros(seq_len, np.int32)
+    fill, seg = 0, 0
+
+    def flush():
+        nonlocal tokens, segs, fill, seg
+        out = {"tokens": tokens, "segment_ids": segs}
+        tokens, segs = np.zeros(seq_len, np.int32), np.zeros(seq_len, np.int32)
+        fill, seg = 0, 0
+        return out
+
+    def place(part):
+        nonlocal fill, seg
+        seg += 1
+        tokens[fill : fill + part.size] = part
+        segs[fill : fill + part.size] = seg
+        fill += part.size
+
+    for ex in examples:
+        ex = np.asarray(ex, np.int32).ravel()
+        if ex.size == 0:
+            continue
+        if ex.size <= seq_len:
+            if ex.size > seq_len - fill:
+                yield flush()
+            place(ex)
+            if fill == seq_len:
+                yield flush()
+        elif split_long:
+            offset = 0
+            while offset < ex.size:
+                if fill == seq_len:
+                    yield flush()
+                take = min(ex.size - offset, seq_len - fill)
+                place(ex[offset : offset + take])
+                offset += take
+        else:
+            if fill:
+                yield flush()
+            place(ex[:seq_len])
+            yield flush()
+    if fill:
+        yield flush()
