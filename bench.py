@@ -193,7 +193,10 @@ def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
 
     t_flash = timed(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)))
     t_dot = timed(jax.jit(lambda q, k, v: _reference_attention(q, k, v, True, 1.0 / np.sqrt(d))))
-    return b * seq / t_flash, t_dot / t_flash
+    # sliding window at W=1024: stale K/V blocks are skipped + DMAs elided,
+    # so this should approach full-flash-time x (W / S) as S grows
+    t_win = timed(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, window=1024)))
+    return b * seq / t_flash, t_dot / t_flash, t_flash / t_win
 
 
 _METRICS_WORKER = """
@@ -310,7 +313,7 @@ def main():
     batch = synthetic_batch(np.random.RandomState(0))
     raw_ips = bench_raw(batch)
     fw_ips = bench_framework(batch)
-    flash_tps, flash_speedup = bench_flash()
+    flash_tps, flash_speedup, window_speedup = bench_flash()
     metrics_p50 = bench_metrics_allreduce()
     print(
         json.dumps(
@@ -325,6 +328,7 @@ def main():
                     "raw_mfu": round(raw_ips * TRAIN_FLOPS_PER_IMAGE / chip_peak_flops(), 4),
                     "flash_attn_tokens_per_sec_s8k": round(flash_tps, 1),
                     "flash_attn_speedup_vs_unfused_s8k": round(flash_speedup, 3),
+                    "flash_attn_window1k_speedup_vs_full_s8k": round(window_speedup, 3),
                     "metrics_allreduce_p50_ms_8proc_12metrics": (
                         round(metrics_p50, 3) if metrics_p50 is not None else None
                     ),

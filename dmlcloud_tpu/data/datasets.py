@@ -545,8 +545,12 @@ def pack_sequences(
     reference has no packing; this is TPU-side scope — static shapes
     without burning FLOPs on padding.)
     """
-    if seq_len < 1:
+    if seq_len < 1:  # validate eagerly — the generator body runs lazily
         raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    return _pack_sequences_iter(examples, seq_len, split_long)
+
+
+def _pack_sequences_iter(examples, seq_len, split_long):
     tokens = np.zeros(seq_len, np.int32)
     segs = np.zeros(seq_len, np.int32)
     fill, seg = 0, 0
