@@ -59,10 +59,29 @@ class LMStage(dml.TrainValStage):
         model = DecoderLM(model_cfg)
         self.model = model  # kept for post-run sampling (--sample)
 
-        tokens = synthetic_tokens(cfg.vocab_size, cfg.n_seqs, cfg.seq_len)
-        self.sample_prompt = tokens[:2, :16].copy()
-        n_val = max(cfg.batch_size, cfg.n_seqs // 10)
+        if cfg.get("pack", False):
+            # variable-length corpus packed into full rows: the packer emits
+            # {"tokens", "segment_ids"} and the step routes them through the
+            # segment-isolated attention + masked loss path
+            from dmlcloud_tpu.data import pack_sequences
+
+            rng = np.random.RandomState(1)
+            # ids shifted +1 below so pad id 0 never collides with a token
+            full = synthetic_tokens(cfg.vocab_size - 1, cfg.n_seqs, cfg.seq_len)
+            pieces = [row[: rng.randint(cfg.seq_len // 4, cfg.seq_len + 1)] + 1 for row in full]
+            rows = list(pack_sequences(pieces, cfg.seq_len))
+            tokens = np.stack([np.stack([r["tokens"], r["segment_ids"]]) for r in rows])  # [N, 2, T]
+            self.sample_prompt = full[:2, :16] + 1  # corpus-distribution prompt, shifted like training
+        else:
+            tokens = synthetic_tokens(cfg.vocab_size, cfg.n_seqs, cfg.seq_len)
+            self.sample_prompt = tokens[:2, :16].copy()
+        n_val = max(cfg.batch_size, len(tokens) // 10)
         bs = cfg.batch_size
+        if (len(tokens) - n_val) < bs:
+            raise ValueError(
+                f"{len(tokens)} rows after packing/splitting leave fewer than one "
+                f"train batch (batch_size={bs}, val={n_val}); raise --n-seqs or lower --batch-size"
+            )
 
         def loader(data):
             class Loader:
@@ -90,6 +109,10 @@ class LMStage(dml.TrainValStage):
         return 1.0
 
     def step(self, state, batch):
+        if self.config.get("pack", False):
+            toks, segs = batch[:, 0], batch[:, 1]
+            logits = state.apply_fn({"params": state.params}, toks, segment_ids=segs)
+            return lm_loss(logits, toks, segment_ids=segs)
         logits = state.apply_fn({"params": state.params}, batch)
         return lm_loss(logits, batch)
 
@@ -105,6 +128,7 @@ def main():
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--attn", choices=["dot", "flash", "ring"], default="dot")
     parser.add_argument("--window", type=int, default=None, help="sliding-window attention width")
+    parser.add_argument("--pack", action="store_true", help="pack a variable-length corpus (segment_ids path)")
     parser.add_argument("--remat", action="store_true", help="recompute blocks in the backward pass (long-context memory)")
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
@@ -113,6 +137,9 @@ def main():
         help="after training, greedy-decode N tokens from a corpus prompt (KV-cache generate)",
     )
     args = parser.parse_args()
+
+    if args.pack and args.attn != "dot":
+        parser.error("--pack (segment_ids) currently requires --attn dot")
 
     init_auto(verbose=True)
 
@@ -126,6 +153,7 @@ def main():
         "attn": args.attn,
         "remat": args.remat,
         "window": args.window,
+        "pack": args.pack,
         "seed": 0,
     }
     pipeline = dml.TrainingPipeline(config, name=f"lm-{args.preset}")
