@@ -12,7 +12,8 @@ TPU-first shape of the problem:
   ``lax.scan`` over decode steps. No per-token Python dispatch; the only
   host transfer is the final token matrix.
 - Sampling is functional: greedy at ``temperature=0``, otherwise
-  temperature softmax with optional top-k truncation, PRNG folded per step.
+  temperature softmax with optional top-k and nucleus (top-p) truncation,
+  PRNG folded per step.
 """
 
 from __future__ import annotations
@@ -36,7 +37,7 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int | None = No
     }
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
+def _sample(logits, rng, temperature: float, top_k: int, top_p: float):
     """logits: [B, V] fp32 -> tokens [B] int32."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -44,11 +45,20 @@ def _sample(logits, rng, temperature: float, top_k: int):
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution whose
+        # mass reaches top_p (the first token always stays)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        csum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        cutoff_idx = jnp.sum(csum < top_p, axis=-1, keepdims=True)  # first index reaching p
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "max_new_tokens", "temperature", "top_k", "eos_id", "pad_id")
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id", "pad_id"),
 )
 def _generate_compiled(
     model: DecoderLM,
@@ -58,6 +68,7 @@ def _generate_compiled(
     max_new_tokens: int,
     temperature: float,
     top_k: int,
+    top_p: float,
     eos_id: int,
     pad_id: int,
 ):
@@ -70,7 +81,7 @@ def _generate_compiled(
     last = logits[:, -1]  # [B, V]
 
     def sample_next(prev_logits, rng, done):
-        tok = _sample(prev_logits, rng, temperature, top_k)
+        tok = _sample(prev_logits, rng, temperature, top_k, top_p)
         tok = jnp.where(done, pad_id, tok)
         return tok, done | (tok == eos_id)
 
@@ -97,6 +108,7 @@ def generate(
     *,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     rng: jax.Array | None = None,
     eos_id: int = -1,
     pad_id: int = 0,
@@ -104,8 +116,8 @@ def generate(
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T] int32
     (uniform prompt length across the batch). Greedy when
     ``temperature == 0``; otherwise temperature sampling with optional
-    ``top_k`` truncation. Rows that emit ``eos_id`` keep emitting
-    ``pad_id``. Returns [B, max_new_tokens] int32.
+    ``top_k`` / nucleus ``top_p`` truncation. Rows that emit ``eos_id``
+    keep emitting ``pad_id``. Returns [B, max_new_tokens] int32.
 
     The whole generation — prefill + scan over decode steps — is one
     compiled program; recompiles happen only when shapes or the static
@@ -121,5 +133,5 @@ def generate(
         rng = jax.random.PRNGKey(0)
     return _generate_compiled(
         model, params, prompt, rng,
-        int(max_new_tokens), float(temperature), int(top_k), int(eos_id), int(pad_id),
+        int(max_new_tokens), float(temperature), int(top_k), float(top_p), int(eos_id), int(pad_id),
     )

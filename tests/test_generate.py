@@ -106,3 +106,17 @@ def test_init_cache_shapes():
     cache = init_cache(cfg, batch_size=3, max_len=32)
     assert set(cache) == {"layer_0", "layer_1"}
     assert cache["layer_0"]["k"].shape == (3, 32, 2, 8)
+
+
+def test_top_p_sampling():
+    cfg = _tiny_cfg()
+    model, params, prompt = _init(cfg)
+    # tiny nucleus -> effectively greedy (only the argmax survives the cutoff)
+    tight = generate(model, params, prompt, 5, temperature=1.0, top_p=1e-6, rng=jax.random.PRNGKey(3))
+    greedy = generate(model, params, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(tight), np.asarray(greedy))
+    # permissive nucleus is deterministic under a fixed rng and in range
+    a = generate(model, params, prompt, 5, temperature=1.0, top_p=0.9, rng=jax.random.PRNGKey(4))
+    b = generate(model, params, prompt, 5, temperature=1.0, top_p=0.9, rng=jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ((np.asarray(a) >= 0) & (np.asarray(a) < cfg.vocab_size)).all()
