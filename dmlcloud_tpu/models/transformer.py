@@ -41,8 +41,10 @@ class TransformerConfig:
     tie_embeddings: bool = False
     attn_impl: str = "dot"  # 'dot' | 'flash' | 'ring'
     # Sliding-window attention (Mistral convention): each token attends to
-    # itself + the previous W-1. Supported by 'dot' and 'flash' (where stale
-    # K/V blocks are skipped — O(T*W) compute), and by the decode cache.
+    # itself + the previous W-1. Supported by every impl: 'dot'/'flash'
+    # (stale K/V blocks skipped — O(T*W) compute), 'ring' (the ring visits
+    # only 1 + ceil((W-1)/Tl) blocks — O(W) communication), and the decode
+    # cache.
     sliding_window: int | None = None
     # MoE: replace the dense MLP with an expert-parallel MoEMLP (models/moe.py)
     # in every ``moe_every``-th block (0 = dense everywhere). Experts shard
@@ -72,11 +74,8 @@ class TransformerConfig:
         if self.attn_impl not in ("dot", "flash", "ring"):
             # a typo here would otherwise silently run the unfused path
             raise ValueError(f"attn_impl must be 'dot', 'flash' or 'ring', got {self.attn_impl!r}")
-        if self.sliding_window is not None:
-            if self.sliding_window < 1:
-                raise ValueError(f"sliding_window must be >= 1, got {self.sliding_window}")
-            if self.attn_impl == "ring":
-                raise ValueError("sliding_window is not supported with attn_impl='ring'")
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(f"sliding_window must be >= 1, got {self.sliding_window}")
 
     @property
     def kv_heads(self) -> int:
@@ -220,11 +219,15 @@ class Attention(nn.Module):
             if cfg.mesh is not None:
                 from ..ops.ring_attention import ring_attention_sharded
 
-                out = ring_attention_sharded(q, k, v, cfg.mesh, axis_name=cfg.seq_axis, causal=True)
+                out = ring_attention_sharded(
+                    q, k, v, cfg.mesh, axis_name=cfg.seq_axis, causal=True, window=cfg.sliding_window
+                )
             else:
                 from ..ops.ring_attention import ring_attention
 
-                out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
+                out = ring_attention(
+                    q, k, v, axis_name=cfg.seq_axis, causal=True, window=cfg.sliding_window
+                )
         elif cfg.sliding_window is not None:
             pos = jnp.arange(t)
             q_pos, k_pos = pos[:, None], pos[None, :]

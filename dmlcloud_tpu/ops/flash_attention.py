@@ -73,6 +73,38 @@ def _window_mask(s, q0, k0, q_block, block_k, causal: bool, window: int | None):
     return jnp.where(keep, s, _NEG_INF)
 
 
+def _maybe_when(cond, fn):
+    """Run ``fn`` under ``pl.when`` unless the condition is statically True."""
+    if cond is True:
+        fn()
+    else:
+        pl.when(cond)(fn)
+
+
+def _kv_skip_cond(qi, kb, q_block: int, block_k: int, causal: bool, window: int | None):
+    """Participation condition for a (q-block, streamed K-block) pair —
+    shared by the forward and dQ kernels so their skip bounds can never
+    drift from each other (a divergence would feed exp(s - lse) garbage
+    into whichever side still ran the block)."""
+    cond = True
+    if causal:
+        cond = kb * block_k <= qi * q_block + q_block - 1
+    if window is not None:
+        cond &= kb * block_k + block_k - 1 >= qi * q_block - window + 1
+    return cond
+
+
+def _q_skip_cond(qb, kb, block_q: int, k_block: int, causal: bool, window: int | None):
+    """The dK/dV kernel's transposed participation condition (fixed KV
+    block, streamed Q block) — the mirror of :func:`_kv_skip_cond`."""
+    cond = True
+    if causal:
+        cond = (qb + 1) * block_q - 1 >= kb * k_block
+    if window is not None:
+        cond &= qb * block_q <= kb * k_block + k_block + window - 2
+    return cond
+
+
 def _attn_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest, block_k: int, causal: bool, sm_scale: float, q_block: int,
     num_kb: int, window: int | None
@@ -123,21 +155,23 @@ def _attn_kernel(
         l_ref[...] = jnp.broadcast_to(l_prev * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
         acc_ref[...] = acc_ref[...] * correction + pv
 
-    if causal:
-        # K blocks fully past the diagonal contribute nothing — skip them;
-        # with a sliding window, so do blocks entirely older than the window
-        cond = kb * block_k <= qi * q_block + q_block - 1
-        if window is not None:
-            cond &= kb * block_k + block_k - 1 >= qi * q_block - window + 1
-        pl.when(cond)(_accumulate)
-    else:
-        _accumulate()
+    # K blocks fully past the diagonal (causal) or entirely older than the
+    # window contribute nothing — skip them (window applies without causal
+    # too: the ring's behind-hops call with causal=False and a shifted
+    # window)
+    _maybe_when(_kv_skip_cond(qi, kb, q_block, block_k, causal, window), _accumulate)
 
     @pl.when(kb == num_kb - 1)
     def _write():
-        o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+        # dead rows (every K block skipped — possible for windowed
+        # non-causal ring hops) keep l == 0: the tiny floor makes their
+        # output 0 and their lse ~ -1e30 - 69 (FINITE, so the ring merge
+        # weight underflows to exactly 0 and the backward's exp(s - lse)
+        # stays finite); live rows always have l >~ 1, untouched
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
         if lse_ref is not None:
-            lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
+            lse_ref[0] = m_ref[...] + jnp.log(l_safe)
 
 
 def _dq_kernel(
@@ -174,13 +208,7 @@ def _dq_kernel(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
-        cond = kb * block_k <= qi * q_block + q_block - 1
-        if window is not None:
-            cond &= kb * block_k + block_k - 1 >= qi * q_block - window + 1
-        pl.when(cond)(_accumulate)
-    else:
-        _accumulate()
+    _maybe_when(_kv_skip_cond(qi, kb, q_block, block_k, causal, window), _accumulate)
 
     @pl.when(kb == num_kb - 1)
     def _write():
@@ -225,15 +253,9 @@ def _dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ).astype(dk_ref.dtype)
 
-    if causal:
-        # skip q blocks entirely above the diagonal (their p is all zero);
-        # with a sliding window, also q blocks entirely past k_last + window
-        cond = (qb + 1) * block_q - 1 >= kb * k_block
-        if window is not None:
-            cond &= qb * block_q <= kb * k_block + k_block + window - 2
-        pl.when(cond)(_accumulate)
-    else:
-        _accumulate()
+    # skip q blocks entirely above the diagonal (causal — their p is all
+    # zero) or entirely past k_last + window (windowed, causal or not)
+    _maybe_when(_q_skip_cond(qb, kb, block_q, k_block, causal, window), _accumulate)
 
 
 def _auto_block(requested: int, seq: int) -> int:
@@ -399,17 +421,24 @@ def _make_kv_index(h: int, kh: int):
     return kv_index
 
 
-def _clamp_kv_stream(kb, qi, block_q: int, block_k: int, causal: bool, window: int | None = None):
+def _clamp_kv_stream(kb, qi, block_q: int, block_k: int, causal: bool, window: int | None = None, num_kb: int = 1):
     """Clamp the streamed K-block index under causal masking so fully skipped
     grid steps (past the diagonal — and, with a sliding window, older than
     the window) re-request an adjacent participating block index — Mosaic
     elides the DMA when consecutive steps map to the same block, saving the
     K/V HBM traffic that `pl.when` alone would still copy and discard."""
-    if not causal:
+    if not causal and window is None:
         return kb
-    hi = ((qi + 1) * block_q - 1) // block_k
+    lo = None
     if window is not None:
-        lo = jnp.maximum(qi * block_q - window + 1, 0) // block_k
+        # cap inside the grid: a strongly negative shifted window can push
+        # the raw lo past the last block — the pl.when skip covers those
+        # steps, but the INDEX handed to the DMA must still be in range
+        lo = jnp.minimum(jnp.maximum(qi * block_q - window + 1, 0) // block_k, num_kb - 1)
+    if not causal:
+        return jnp.maximum(kb, lo)
+    hi = ((qi + 1) * block_q - 1) // block_k
+    if lo is not None:
         return jnp.clip(kb, lo, hi)
     return jnp.minimum(kb, hi)
 
@@ -419,11 +448,15 @@ def _clamp_q_stream(qb, kb, block_q: int, block_k: int, causal: bool, window: in
     above the diagonal (or, with a sliding window, entirely past
     k_last + window) for this KV block are clamped to an adjacent
     participating block."""
-    if not causal:
+    if not causal and window is None:
         return qb
-    lo = (kb * block_k) // block_q
+    hi = None
     if window is not None:
-        hi = (kb * block_k + block_k - 1 + window - 1) // block_q
+        hi = jnp.maximum(kb * block_k + block_k - 1 + window - 1, 0) // block_q
+    if not causal:
+        return jnp.clip(qb, 0, hi)
+    lo = (kb * block_k) // block_q
+    if hi is not None:
         return jnp.clip(qb, lo, hi)
     return jnp.maximum(qb, lo)
 
@@ -454,7 +487,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, wind
     vmem = {"memory_space": _VMEM}
 
     def kv_block(bh, qi, kb):
-        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window), 0)
+        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb), 0)
 
     out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem)]
@@ -512,7 +545,7 @@ def _flash_bwd_impl(
     vmem = {"memory_space": _VMEM}
 
     def kv_block(bh, qi, kb):
-        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window), 0)
+        return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb), 0)
 
     num_kb = s // block_k
     dq = pl.pallas_call(

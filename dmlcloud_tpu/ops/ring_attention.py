@@ -41,6 +41,18 @@ from .flash_attention import flash_attention
 _NEG_INF = -1e30
 
 
+def _merge_partials(m, w, acc, out_b, lse_b):
+    """Blockwise combination of normalized attention partials:
+    out = Σ_b exp(lse_b)·out_b / Σ_b exp(lse_b), carried with a running max
+    for stability. The ONE numerically sensitive merge, shared by the
+    scanned and the windowed-unrolled ring loops."""
+    new_m = jnp.maximum(m, lse_b)
+    c_prev = jnp.exp(m - new_m)
+    c_new = jnp.exp(lse_b - new_m)
+    acc = acc * c_prev[..., None] + out_b.astype(jnp.float32) * c_new[..., None]
+    return new_m, w * c_prev + c_new, acc
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -51,16 +63,31 @@ def ring_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Shapes (per device): q [B, Tl, H, D]; k/v [B, Tl, KH, D] where Tl is the
     local sequence block. Must be called inside shard_map/pmap with
     ``axis_name`` mapped. Returns [B, Tl, H, D].
+
+    ``window`` = W (requires ``causal``) makes the attention sliding-window
+    over GLOBAL positions — and because the ring step distance is static,
+    the ring visits only ``1 + ceil((W-1)/Tl)`` blocks instead of all n:
+    long-context windowed training communicates O(W), not O(T).
     """
     b, tl, h, d = q.shape
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window ring attention) requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        return _ring_attention_windowed(
+            q, k, v, axis_name, int(window), sm_scale, block_q, block_k, interpret
+        )
 
     flash = partial(
         flash_attention,
@@ -99,21 +126,83 @@ def ring_attention(
         else:
             out_b, lse_b = behind_block(q, kb, vb)
 
-        # blockwise merge of normalized partials: out = Σ_b exp(lse_b) out_b
-        # / Σ_b exp(lse_b), computed with a running max for stability
-        new_m = jnp.maximum(m, lse_b)
-        c_prev = jnp.exp(m - new_m)
-        c_new = jnp.exp(lse_b - new_m)
-        acc = acc * c_prev[..., None] + out_b.astype(jnp.float32) * c_new[..., None]
-        w = w * c_prev + c_new
+        m, w, acc = _merge_partials(m, w, acc, out_b, lse_b)
 
         # rotate K/V around the ring (ICI neighbour exchange, overlaps compute)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (new_m, w, acc, kb, vb), None
+        return (m, w, acc, kb, vb), None
 
     (m, w, acc, _, _), _ = jax.lax.scan(body, (m0, w0, acc0, k, v), jnp.arange(n))
+    return (acc / w[..., None]).astype(q.dtype)
+
+
+def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, block_k, interpret):
+    """Causal sliding-window ring attention.
+
+    The ring step distance is STATIC (at hop ``step``, a device either holds
+    the block exactly ``step`` positions behind it, or a wrapped-around
+    ahead-block it must skip), so the loop unrolls in Python: hop 0 is the
+    diagonal (causal + window), hop ``step`` uses the flash kernel with the
+    distance-shifted relative cutoff ``window - step*Tl``, and hops whose
+    nearest pair is already outside the window never run — the loop AND the
+    ppermutes stop after ``1 + ceil((window-1)/Tl)`` hops. Dead rows (no
+    valid key in a visiting block — every kernel block skipped) get a
+    floored lse of ~ -1e30 from the kernel write, so their merge weight
+    underflows to exactly zero, forward and backward."""
+    import math as _math
+
+    from .flash_attention import _auto_block, _flash_lse
+
+    b, tl, h, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = 1.0 / _math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq, bk = _auto_block(block_q, tl), _auto_block(block_k, tl)
+
+    # hop `step` >= 1 participates iff its closest pair distance
+    # (step-1)*Tl + 1 is still inside the window
+    steps_needed = min(n, max(1, (window - 2) // tl + 2))
+
+    m0 = jnp.full((b, tl, h), _NEG_INF, jnp.float32)
+    w0 = jnp.zeros((b, tl, h), jnp.float32)
+    acc0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    m, w, acc, kb, vb = m0, w0, acc0, k, v
+
+    def to_bth(lse):  # [B*H, Tl] kernel residual -> [B, Tl, H]
+        return lse.reshape(b, h, tl).transpose(0, 2, 1)
+
+    for step in range(steps_needed):
+        if step == 0:
+            out_b, lse_b = _flash_lse(q, kb, vb, True, float(sm_scale), bq, bk, bool(interpret), window)
+            lse_b = to_bth(lse_b)
+        else:
+            # a device holds the block `step` behind it iff idx >= step;
+            # otherwise the wrapped block is AHEAD and fully masked
+            w_eff = window - step * tl  # static relative cutoff in local coords
+
+            def behind(q, kb, vb):
+                o, l = _flash_lse(q, kb, vb, False, float(sm_scale), bq, bk, bool(interpret), w_eff)
+                return o, to_bth(l)
+
+            def ahead(q, kb, vb):
+                return (
+                    jnp.zeros((b, tl, h, d), q.dtype),
+                    jnp.full((b, tl, h), _NEG_INF, jnp.float32),
+                )
+
+            out_b, lse_b = jax.lax.cond(idx >= step, behind, ahead, q, kb, vb)
+        m, w, acc = _merge_partials(m, w, acc, out_b, lse_b)
+
+        if step < steps_needed - 1:  # no rotation after the last used hop
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+
     return (acc / w[..., None]).astype(q.dtype)
 
 
@@ -128,12 +217,23 @@ def ring_attention_sharded(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Ring attention callable under plain jit: shard_maps itself over
     ``mesh`` with the sequence dim (axis 1) split on ``axis_name`` and batch
-    on the data axes when present."""
-    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names) or None
-    spec_q = P(batch_axes, axis_name, None, None)
+    on the data axes when they divide it (a batch too small for the data
+    axes — e.g. module.init's example input — stays replicated)."""
+    if axis_name in mesh.shape and q.shape[1] % mesh.shape[axis_name]:
+        raise ValueError(
+            f"sequence length {q.shape[1]} is not divisible by mesh axis "
+            f"{axis_name!r} of size {mesh.shape[axis_name]}"
+        )
+    batch_axes, rem = [], q.shape[0]
+    for a in ("data", "fsdp"):
+        if a in mesh.axis_names and rem % mesh.shape[a] == 0:
+            batch_axes.append(a)
+            rem //= mesh.shape[a]
+    spec_q = P(tuple(batch_axes) or None, axis_name, None, None)
 
     fn = partial(
         ring_attention,
@@ -143,6 +243,7 @@ def ring_attention_sharded(
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        window=window,
     )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q, check_vma=False

@@ -235,6 +235,59 @@ class TestRingAttention:
             )
 
 
+class TestWindowedRing:
+    """Sliding-window ring attention: global-position window over the sharded
+    sequence, truncated ring rotation."""
+
+    @pytest.mark.parametrize("window", [1, 5, 8, 13, 40, 64])
+    def test_matches_windowed_reference(self, window):
+        """Windows smaller than, equal to, and spanning multiple local
+        blocks (Tl=8 at 8 devices), incl. full-seq."""
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        mesh = mesh_lib.create_mesh({"seq": 8})
+        q, k, v = _qkv(b=1, t=64, h=2, d=16, seed=11)
+        expected = _reference_attention(q, k, v, True, 1.0 / np.sqrt(16), window=window)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("window", [5, 13])
+    def test_grads_match_windowed_reference(self, window):
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        mesh = mesh_lib.create_mesh({"seq": 8})
+        q, k, v = _qkv(b=1, t=64, h=2, d=16, seed=12)
+        cot = jnp.asarray(np.random.RandomState(13).randn(*q.shape), q.dtype)
+
+        def ring_loss(q, k, v):
+            return jnp.vdot(ring_attention_sharded(q, k, v, mesh, causal=True, window=window), cot)
+
+        def ref_loss(q, k, v):
+            return jnp.vdot(_reference_attention(q, k, v, True, 1.0 / np.sqrt(16), window=window), cot)
+
+        got = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=1e-4, rtol=1e-4, err_msg=f"d{name}"
+            )
+
+    def test_gqa_windowed_ring(self):
+        from dmlcloud_tpu.ops.flash_attention import _reference_attention
+
+        mesh = mesh_lib.create_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=1, t=32, h=4, kh=2, d=16, seed=14)
+        expected = _reference_attention(q, k, v, True, 1.0 / np.sqrt(16), window=11)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, window=11)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_window_requires_causal(self):
+        mesh = mesh_lib.create_mesh({"seq": 4}, devices=jax.devices()[:4])
+        q, k, v = _qkv(b=1, t=32, h=2, d=16)
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention_sharded(q, k, v, mesh, causal=False, window=8)
+
+
 class TestFlashLse:
     def test_lse_value(self):
         """return_lse must equal the actual logsumexp of scaled scores."""

@@ -141,6 +141,16 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(packed[0, :6]), np.asarray(la[0]), atol=1e-5)
         np.testing.assert_allclose(np.asarray(packed[0, 6:]), np.asarray(lb[0]), atol=1e-5)
 
-    def test_ring_rejects_window(self):
-        with pytest.raises(ValueError, match="ring"):
-            _cfg(sliding_window=8, attn_impl="ring")
+    def test_ring_windowed_matches_dot(self):
+        """ring + sliding_window on a real seq mesh equals the dot path."""
+        from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.create_mesh({"seq": 4}, devices=jax.devices()[:4])
+        cfg_dot = _cfg(sliding_window=9, max_seq_len=32)
+        cfg_ring = _cfg(sliding_window=9, max_seq_len=32, attn_impl="ring", mesh=mesh)
+        rng = np.random.RandomState(6)
+        toks = jnp.asarray(rng.randint(0, 37, size=(2, 32)), jnp.int32)
+        params = DecoderLM(cfg_dot).init(jax.random.PRNGKey(0), toks)["params"]
+        out_dot = DecoderLM(cfg_dot).apply({"params": params}, toks)
+        out_ring = DecoderLM(cfg_ring).apply({"params": params}, toks)
+        np.testing.assert_allclose(np.asarray(out_dot), np.asarray(out_ring), atol=2e-4, rtol=2e-4)
