@@ -43,18 +43,23 @@ def _interleave_rope_rows(w: np.ndarray) -> np.ndarray:
 
 
 def transformer_config_from_hf(hf_config: Any, **overrides) -> TransformerConfig:
-    """Build a :class:`TransformerConfig` from a HF ``LlamaConfig``."""
+    """Build a :class:`TransformerConfig` from a HF ``LlamaConfig`` /
+    ``MistralConfig`` (same architecture; Mistral's ``sliding_window``
+    carries over into the model's windowed attention paths)."""
     base = dict(
         vocab_size=hf_config.vocab_size,
         num_layers=hf_config.num_hidden_layers,
         num_heads=hf_config.num_attention_heads,
         num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-        head_dim=hf_config.hidden_size // hf_config.num_attention_heads,
+        # some Mistral-family configs decouple head_dim from hidden/heads
+        head_dim=getattr(hf_config, "head_dim", None)
+        or hf_config.hidden_size // hf_config.num_attention_heads,
         hidden_dim=hf_config.hidden_size,
         mlp_dim=hf_config.intermediate_size,
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        sliding_window=getattr(hf_config, "sliding_window", None),
     )
     base.update(overrides)
     return TransformerConfig(**base)
@@ -119,3 +124,52 @@ def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: TransformerConfig, 
     import jax
 
     return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), params)
+
+
+def _split_rope_rows(w: np.ndarray) -> np.ndarray:
+    """[..., D] interleaved rotary layout -> half-split (inverse of
+    :func:`_interleave_rope_rows`)."""
+    d = w.shape[-1]
+    out = np.empty_like(w)
+    out[..., : d // 2] = w[..., 0::2]
+    out[..., d // 2 :] = w[..., 1::2]
+    return out
+
+
+def hf_state_dict_from_params(params: Any, cfg: TransformerConfig) -> dict:
+    """The inverse of :func:`llama_params_from_hf`: export this model's
+    params as a ``LlamaForCausalLM``/``MistralForCausalLM`` state dict of
+    float32 numpy arrays (wrap in ``torch.from_numpy`` to ``load_state_dict``
+    into a HF model) — train on TPU, serve anywhere HF runs."""
+    h, kh, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+    def qkv_weight(kernel, heads: int, rope: bool) -> np.ndarray:
+        w = _np(kernel).transpose(1, 2, 0)  # [heads, d, hid]
+        if rope:
+            w = _split_rope_rows(w.transpose(0, 2, 1)).transpose(0, 2, 1)
+        return np.ascontiguousarray(w.reshape(heads * d, -1))
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["embed"]["embedding"]),
+        "model.norm.weight": _np(params["final_norm"]["scale"]),
+    }
+    if cfg.tie_embeddings:
+        # HF tied models still materialise the tied key in their state dict,
+        # and a strict load_state_dict requires it
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = np.ascontiguousarray(_np(params["lm_head"]["kernel"]).T)
+    for i in range(cfg.num_layers):
+        layer = params[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _np(layer["attn_norm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _np(layer["mlp_norm"]["scale"])
+        attn, mlp = layer["attn"], layer["mlp"]
+        sd[p + "self_attn.q_proj.weight"] = qkv_weight(attn["q_proj"]["kernel"], h, rope=True)
+        sd[p + "self_attn.k_proj.weight"] = qkv_weight(attn["k_proj"]["kernel"], kh, rope=True)
+        sd[p + "self_attn.v_proj.weight"] = qkv_weight(attn["v_proj"]["kernel"], kh, rope=False)
+        sd[p + "self_attn.o_proj.weight"] = np.ascontiguousarray(_np(attn["o_proj"]["kernel"]).T)
+        sd[p + "mlp.gate_proj.weight"] = np.ascontiguousarray(_np(mlp["gate_proj"]["kernel"]).T)
+        sd[p + "mlp.up_proj.weight"] = np.ascontiguousarray(_np(mlp["up_proj"]["kernel"]).T)
+        sd[p + "mlp.down_proj.weight"] = np.ascontiguousarray(_np(mlp["down_proj"]["kernel"]).T)
+    return sd

@@ -85,3 +85,92 @@ def test_unconverted_weight_raises():
     sd["model.layers.0.unexpected.weight"] = torch.zeros(2)
     with pytest.raises(ValueError, match="unconverted"):
         llama_params_from_hf(sd, cfg)
+
+
+def test_mistral_config_and_logits():
+    """Mistral = same architecture + sliding_window; converted weights must
+    match the HF Mistral forward (whose eager attention applies the window)."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=61,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        sliding_window=6,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.sliding_window == 6
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+
+    tokens = np.random.RandomState(3).randint(0, 61, size=(2, 13))
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = DecoderLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_export_round_trips_through_hf():
+    """params -> HF state dict -> load into a live HF model -> logits match;
+    and importing the exported dict reproduces the original params."""
+    from dmlcloud_tpu.models.hf import hf_state_dict_from_params
+
+    hf_cfg, hf_model = _tiny_hf()
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+
+    sd = hf_state_dict_from_params(params, cfg)
+    fresh = transformers.LlamaForCausalLM(hf_cfg).eval()
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+
+    tokens = np.random.RandomState(4).randint(0, 61, size=(1, 10))
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+        got = fresh(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # exact param round trip (same treedef => leaves align positionally)
+    back = llama_params_from_hf(sd, cfg)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(back)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decoupled_head_dim():
+    """Mistral-Nemo-style configs set head_dim independently of
+    hidden_size // num_heads."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=61, hidden_size=40, intermediate_size=64, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=64, sliding_window=None, attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.head_dim == 16 and cfg.hidden_dim == 40
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.RandomState(5).randint(0, 61, size=(1, 9))
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = DecoderLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_tied_export_loads_strict():
+    from dmlcloud_tpu.models.hf import hf_state_dict_from_params
+
+    hf_cfg, hf_model = _tiny_hf(tie=True)
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+    sd = hf_state_dict_from_params(params, cfg)
+    fresh = transformers.LlamaForCausalLM(hf_cfg).eval()
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})  # strict
+    tokens = np.random.RandomState(6).randint(0, 61, size=(1, 8))
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+        got = fresh(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
