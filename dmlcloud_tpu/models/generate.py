@@ -100,6 +100,13 @@ def _generate_compiled(
     return jnp.concatenate([tokens, final_tok[None]], axis=0).T  # [B, max_new_tokens]
 
 
+def _check_len(model: DecoderLM, t: int, max_new_tokens: int) -> None:
+    if t + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds max_seq_len ({model.cfg.max_seq_len})"
+        )
+
+
 def generate(
     model: DecoderLM,
     params: Any,
@@ -125,13 +132,119 @@ def generate(
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t = prompt.shape
-    if t + max_new_tokens > model.cfg.max_seq_len:
-        raise ValueError(
-            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds max_seq_len ({model.cfg.max_seq_len})"
-        )
+    _check_len(model, t, max_new_tokens)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_compiled(
         model, params, prompt, rng,
         int(max_new_tokens), float(temperature), int(top_k), float(top_p), int(eos_id), int(pad_id),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "max_new_tokens", "num_beams", "length_penalty", "eos_id", "pad_id")
+)
+def _beam_search_compiled(
+    model: DecoderLM,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    num_beams: int,
+    length_penalty: float,
+    eos_id: int,
+    pad_id: int,
+):
+    b, t = prompt.shape
+    k = num_beams
+    v = model.cfg.vocab_size
+    neg = jnp.float32(-1e30)
+
+    # Prefill once per batch row, then tile the cache across beams.
+    cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
+    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0)
+    cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, k, axis=0), cache)  # [B*K, ...]
+    first_lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+
+    # Step 0: the K best first tokens seed the beams.
+    scores, tok = jax.lax.top_k(first_lp, k)  # [B, K]
+    finished = tok == eos_id
+    tokens = jnp.full((b, k, max_new_tokens), pad_id, jnp.int32)
+    tokens = tokens.at[:, :, 0].set(tok)
+    lengths = jnp.ones((b, k), jnp.int32)  # emitted tokens incl. eos
+
+    def step(carry, i):
+        cache, tokens, scores, lengths, finished, last_tok = carry
+        # last_tok was emitted at position t + i - 1; its K/V lands there
+        logits, cache = model.apply(
+            {"params": params}, last_tok.reshape(b * k, 1), cache=cache, offset=t + i - 1
+        )
+        lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)).reshape(b, k, v)
+        # finished beams may only extend with pad at no cost; everything else
+        # is impossible, so a finished beam's score freezes
+        pad_only = jnp.full((v,), neg).at[pad_id].set(0.0)
+        lp = jnp.where(finished[..., None], pad_only[None, None], lp)
+
+        cand = scores[..., None] + lp  # [B, K, V]
+        scores, flat_idx = jax.lax.top_k(cand.reshape(b, k * v), k)  # [B, K]
+        beam_idx = flat_idx // v  # which parent beam
+        tok = (flat_idx % v).astype(jnp.int32)
+
+        # reorder per-beam state to follow the winning parents
+        take = lambda x: jnp.take_along_axis(x, beam_idx, axis=1)
+        tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+        lengths, finished = take(lengths), take(finished)
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.take_along_axis(
+                x.reshape(b, k, *x.shape[1:]),
+                beam_idx.reshape(b, k, *([1] * (x.ndim - 1))),
+                axis=1,
+            ).reshape(b * k, *x.shape[1:]),
+            cache,
+        )
+
+        tokens = tokens.at[:, :, i].set(tok)
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (tok == eos_id)
+        return (cache, tokens, scores, lengths, finished, tok), None
+
+    init = (cache, tokens, scores, lengths, finished, tok)
+    (cache, tokens, scores, lengths, finished, _), _ = jax.lax.scan(
+        step, init, jnp.arange(1, max_new_tokens)
+    )
+
+    # pick each row's best beam under GNMT-style length normalisation
+    norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(norm, axis=1)  # [B]
+    best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]
+    best_scores = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+    return best_tokens, best_scores
+
+
+def beam_search(
+    model: DecoderLM,
+    params: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int = 32,
+    *,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding: returns ``(tokens [B, max_new_tokens],
+    scores [B])`` where scores are length-normalised sequence log-probs
+    (``sum logp / len**length_penalty``). Beams that emit ``eos_id`` freeze
+    and pad. Like :func:`generate`, the whole search — prefill, scan, beam
+    reordering (cache gathered along the beam axis) — is ONE compiled
+    program."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, t = prompt.shape
+    _check_len(model, t, max_new_tokens)
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_beams > model.cfg.vocab_size:
+        raise ValueError("num_beams cannot exceed vocab_size")
+    return _beam_search_compiled(
+        model, params, prompt, int(max_new_tokens), int(num_beams),
+        float(length_penalty), int(eos_id), int(pad_id),
     )

@@ -174,3 +174,22 @@ def test_tied_export_loads_strict():
         want = hf_model(torch.from_numpy(tokens)).logits.numpy()
         got = fresh(torch.from_numpy(tokens)).logits.numpy()
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_beam_search_matches_hf():
+    """Same weights, same K: our jitted beam search must produce HF
+    generate(num_beams=K)'s tokens."""
+    from dmlcloud_tpu.models.generate import beam_search
+
+    hf_cfg, hf_model = _tiny_hf()
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+    prompt = np.random.RandomState(7).randint(0, 61, size=(2, 6))
+
+    toks, _ = beam_search(DecoderLM(cfg), params, jnp.asarray(prompt), max_new_tokens=8, num_beams=4)
+    with torch.no_grad():
+        want = hf_model.generate(
+            torch.from_numpy(prompt), max_new_tokens=8, num_beams=4, do_sample=False,
+            pad_token_id=0, eos_token_id=None, length_penalty=1.0, early_stopping=False,
+        ).numpy()[:, 6:]
+    np.testing.assert_array_equal(np.asarray(toks), want)
