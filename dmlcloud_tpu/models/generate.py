@@ -142,15 +142,15 @@ def generate(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "max_new_tokens", "num_beams", "length_penalty", "eos_id", "pad_id")
+    jax.jit, static_argnames=("model", "max_new_tokens", "num_beams", "eos_id", "pad_id")
 )
 def _beam_search_compiled(
     model: DecoderLM,
     params,
     prompt: jnp.ndarray,
+    length_penalty: jnp.ndarray,
     max_new_tokens: int,
     num_beams: int,
-    length_penalty: float,
     eos_id: int,
     pad_id: int,
 ):
@@ -244,7 +244,13 @@ def beam_search(
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     if num_beams > model.cfg.vocab_size:
         raise ValueError("num_beams cannot exceed vocab_size")
+    if not 0 <= pad_id < model.cfg.vocab_size:
+        # pad_id is a scatter index into the finished-beam cost vector; an
+        # out-of-range value would silently corrupt eos handling under jit
+        raise ValueError(f"pad_id must be in [0, vocab_size), got {pad_id}")
+    # length_penalty rides as a traced operand: sweeping it must not
+    # recompile the whole search
     return _beam_search_compiled(
-        model, params, prompt, int(max_new_tokens), int(num_beams),
-        float(length_penalty), int(eos_id), int(pad_id),
+        model, params, prompt, jnp.float32(length_penalty), int(max_new_tokens),
+        int(num_beams), int(eos_id), int(pad_id),
     )

@@ -60,9 +60,32 @@ def transformer_config_from_hf(hf_config: Any, **overrides) -> TransformerConfig
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
         sliding_window=getattr(hf_config, "sliding_window", None),
+        rope_scaling=_rope_scaling_from_hf(getattr(hf_config, "rope_scaling", None)),
     )
     base.update(overrides)
     return TransformerConfig(**base)
+
+
+def _rope_scaling_from_hf(rs: Any) -> tuple | None:
+    """HF ``rope_scaling`` dict -> the config's hashable tuple. Unsupported
+    schemes raise — a silently-dropped scaling would import a Llama-3
+    checkpoint with wrong positional geometry."""
+    if rs is None:
+        return None
+    kind = rs.get("rope_type", rs.get("type"))
+    if kind in (None, "default"):
+        return None
+    if kind == "linear":
+        return ("linear", float(rs["factor"]))
+    if kind == "llama3":
+        return (
+            "llama3",
+            float(rs["factor"]),
+            float(rs["low_freq_factor"]),
+            float(rs["high_freq_factor"]),
+            int(rs["original_max_position_embeddings"]),
+        )
+    raise ValueError(f"unsupported HF rope_scaling type {kind!r} (supported: linear, llama3)")
 
 
 def llama_params_from_hf(state_dict: Mapping[str, Any], cfg: TransformerConfig, dtype=jnp.float32):

@@ -17,6 +17,8 @@ TPU-first:
 
 from __future__ import annotations
 
+import math
+
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -37,6 +39,10 @@ class TransformerConfig:
     mlp_dim: int = 1408  # ~8/3 * hidden, SwiGLU convention
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # RoPE context-extension scaling, as a HASHABLE tuple (the config is a
+    # jit-static aux of the model): ("linear", factor) or
+    # ("llama3", factor, low_freq_factor, high_freq_factor, original_len).
+    rope_scaling: tuple | None = None
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     attn_impl: str = "dot"  # 'dot' | 'flash' | 'ring'
@@ -115,8 +121,40 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+def rope_frequencies(
+    head_dim: int, max_len: int, theta: float, scaling: tuple | None = None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotary cos/sin tables; ``scaling`` applies a context-extension
+    transform to the base frequencies:
+
+    - ``("linear", factor)`` — positions interpolated by 1/factor;
+    - ``("llama3", factor, low_freq_factor, high_freq_factor, orig_len)`` —
+      Llama-3's wavelength-banded scheme: high-frequency components kept,
+      low-frequency ones divided by ``factor``, a smooth ramp between.
+    """
     freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        kind = scaling[0]
+        if kind == "linear":
+            freqs = freqs / float(scaling[1])
+        elif kind == "llama3":
+            _, factor, low_ff, high_ff, orig_len = scaling
+            wavelen = 2.0 * math.pi / freqs
+            low_wl = orig_len / float(low_ff)
+            high_wl = orig_len / float(high_ff)
+            smooth = (orig_len / wavelen - low_ff) / (high_ff - low_ff)
+            scaled = jnp.where(
+                wavelen > low_wl,
+                freqs / factor,  # long wavelengths: fully interpolated
+                jnp.where(
+                    wavelen < high_wl,
+                    freqs,  # short wavelengths: untouched
+                    (1 - smooth) * freqs / factor + smooth * freqs,
+                ),
+            )
+            freqs = scaled
+        else:
+            raise ValueError(f"unsupported rope scaling kind {kind!r}")
     t = jnp.arange(max_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)  # [T, head_dim/2]
     return jnp.cos(angles), jnp.sin(angles)
@@ -327,7 +365,7 @@ class DecoderLM(nn.Module):
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
         )(tokens)
-        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling)
 
         def constrain(x):
             if cfg.act_sharding is None:

@@ -201,3 +201,40 @@ class TestBeamSearch:
             beam_search(model, params, prompt, 4, num_beams=0)
         with pytest.raises(ValueError, match="vocab"):
             beam_search(model, params, prompt, 4, num_beams=100)
+
+    def test_eos_freezes_multi_beam(self):
+        """With k > 1, any beam that emits eos must continue as pure pad
+        (exercises reorder + freeze interaction, not just the k=1 identity)."""
+        from dmlcloud_tpu.models.generate import beam_search
+
+        cfg = _tiny_cfg()
+        for seed in range(3):
+            model, params, prompt = _init(cfg, batch=2, t=5, seed=seed)
+            first = int(np.asarray(generate(model, params, prompt, 1))[0, 0])
+            beams, scores = beam_search(
+                model, params, prompt, 6, num_beams=3, eos_id=first, pad_id=59
+            )
+            out = np.asarray(beams)
+            assert np.isfinite(np.asarray(scores)).all()
+            for row in out:
+                hits = np.where(row == first)[0]
+                if hits.size:
+                    assert (row[hits[0] + 1 :] == 59).all()
+
+    def test_pad_id_validated(self):
+        from dmlcloud_tpu.models.generate import beam_search
+
+        cfg = _tiny_cfg()
+        model, params, prompt = _init(cfg)
+        with pytest.raises(ValueError, match="pad_id"):
+            beam_search(model, params, prompt, 4, num_beams=2, pad_id=-1)
+
+    def test_length_penalty_does_not_recompile(self):
+        from dmlcloud_tpu.models.generate import _beam_search_compiled, beam_search
+
+        cfg = _tiny_cfg()
+        model, params, prompt = _init(cfg)
+        beam_search(model, params, prompt, 3, num_beams=2, length_penalty=0.7)
+        misses = _beam_search_compiled._cache_size()
+        beam_search(model, params, prompt, 3, num_beams=2, length_penalty=1.3)
+        assert _beam_search_compiled._cache_size() == misses

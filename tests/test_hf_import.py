@@ -193,3 +193,49 @@ def test_beam_search_matches_hf():
             pad_token_id=0, eos_token_id=None, length_penalty=1.0, early_stopping=False,
         ).numpy()[:, 6:]
     np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+@pytest.mark.parametrize(
+    "rope_scaling",
+    [
+        {"rope_type": "linear", "factor": 2.0},
+        {
+            "rope_type": "llama3",
+            "factor": 4.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+    ],
+)
+def test_rope_scaled_logits_match_hf(rope_scaling):
+    """Llama-3 / linear rope scaling must reproduce HF's scaled rotary
+    geometry, not silently fall back to plain RoPE."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=61, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_scaling=dict(rope_scaling), attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = transformer_config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.rope_scaling is not None
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.RandomState(8).randint(0, 61, size=(2, 40))  # long enough to scale
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = DecoderLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
+
+
+def test_unsupported_rope_scaling_raises():
+    class FakeCfg:
+        vocab_size, num_hidden_layers, num_attention_heads = 61, 1, 4
+        num_key_value_heads, hidden_size, intermediate_size = 2, 32, 64
+        max_position_embeddings, rope_theta = 64, 10000.0
+        tie_word_embeddings, sliding_window = False, None
+        head_dim = 8
+        rope_scaling = {"rope_type": "yarn", "factor": 2.0}
+
+    with pytest.raises(ValueError, match="yarn"):
+        transformer_config_from_hf(FakeCfg())
