@@ -180,6 +180,31 @@ class DataPipeline(_DatasetBase):
     def map(self, fn: Callable[[Any], Any]) -> "DataPipeline":
         return self._chain(lambda it, _e: (fn(x) for x in it), self._length_fn)
 
+    def shuffle(self, buffer_size: int, seed: int = 0) -> "DataPipeline":
+        """Streaming shuffle through a ``buffer_size`` reservoir (the
+        tf.data idiom): each yield swaps a random buffer slot with the next
+        upstream element, so memory stays O(buffer) on unbounded streams.
+        Reshuffles per epoch via ``set_epoch`` (seed + epoch). Sequence
+        sources already shuffle exactly via index permutation
+        (``from_sequence(shuffle=True)``); this is for iterable sources."""
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+
+        def wrap(it: Iterator, epoch: int | None) -> Iterator:
+            rng = np.random.default_rng(seed + (0 if epoch is None else epoch))
+            buf = []
+            for x in it:
+                buf.append(x)
+                if len(buf) == buffer_size:
+                    j = rng.integers(len(buf))
+                    buf[j], out = buf[-1], buf[j]
+                    buf.pop()
+                    yield out
+            for i in rng.permutation(len(buf)):  # drain in a random order
+                yield buf[i]
+
+        return self._chain(wrap, self._length_fn)
+
     def batch(self, batch_size: int, drop_remainder: bool = False, collate: Callable | None = None) -> "DataPipeline":
         """Group consecutive elements into lists of ``batch_size`` (optionally
         collated, e.g. ``np.stack``)."""

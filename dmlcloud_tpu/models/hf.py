@@ -73,7 +73,11 @@ def _rope_scaling_from_hf(rs: Any) -> tuple | None:
     if rs is None:
         return None
     kind = rs.get("rope_type", rs.get("type"))
-    if kind in (None, "default"):
+    if kind is None:
+        # a scaling dict with no recognizable type key must not silently
+        # import as plain RoPE
+        raise ValueError(f"rope_scaling dict has no 'rope_type'/'type' key: {rs!r}")
+    if kind == "default":
         return None
     if kind == "linear":
         return ("linear", float(rs["factor"]))

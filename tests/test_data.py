@@ -391,3 +391,58 @@ class TestPackSequences:
         assert rows[0]["tokens"].tolist() == [1] * 5 + [0] * 3
         assert rows[1]["tokens"].tolist() == [2] * 6 + [0] * 2
         assert rows[1]["segment_ids"].tolist() == [1] * 6 + [0] * 2
+
+
+class TestShuffleCombinator:
+    def test_permutation_and_epoch_reshuffle(self):
+        from dmlcloud_tpu.data import DataPipeline
+
+        pipe = DataPipeline.from_source(list(range(50))).shuffle(buffer_size=8, seed=3)
+        pipe.set_epoch(0)
+        a = list(pipe)
+        assert sorted(a) == list(range(50))  # a permutation, nothing lost
+        assert a != list(range(50))  # and actually shuffled
+        b = list(pipe)  # same epoch -> same order (deterministic)
+        assert a == b
+        pipe.set_epoch(1)
+        c = list(pipe)
+        assert sorted(c) == list(range(50)) and c != a  # reshuffled per epoch
+
+    def test_locality_bounded_by_buffer(self):
+        """An element cannot appear more than buffer_size positions EARLIER
+        than its source position (reservoir semantics)."""
+        from dmlcloud_tpu.data import DataPipeline
+
+        n, buf = 200, 16
+        pipe = DataPipeline.from_source(list(range(n))).shuffle(buffer_size=buf, seed=0)
+        pipe.set_epoch(0)
+        out = list(pipe)
+        for pos, val in enumerate(out):
+            assert pos >= val - (buf - 1)
+
+    def test_buffer_one_is_identity(self):
+        from dmlcloud_tpu.data import DataPipeline
+
+        pipe = DataPipeline.from_source(list(range(10))).shuffle(buffer_size=1)
+        pipe.set_epoch(0)
+        assert list(pipe) == list(range(10))
+
+    def test_rejects_bad_buffer(self):
+        from dmlcloud_tpu.data import DataPipeline
+
+        with pytest.raises(ValueError, match="buffer_size"):
+            DataPipeline.from_source([1]).shuffle(buffer_size=0)
+
+    def test_composes_with_batch(self):
+        from dmlcloud_tpu.data import DataPipeline
+
+        pipe = (
+            DataPipeline.from_source([np.asarray([i]) for i in range(24)])
+            .shuffle(buffer_size=6, seed=1)
+            .batch(4)
+        )
+        pipe.set_epoch(0)
+        batches = list(pipe)
+        assert len(batches) == 6
+        got = sorted(int(v) for b in batches for v in np.asarray(b).ravel())
+        assert got == list(range(24))

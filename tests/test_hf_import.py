@@ -239,3 +239,12 @@ def test_unsupported_rope_scaling_raises():
 
     with pytest.raises(ValueError, match="yarn"):
         transformer_config_from_hf(FakeCfg())
+
+
+def test_rope_scaling_without_type_key_raises():
+    from dmlcloud_tpu.models.hf import _rope_scaling_from_hf
+
+    with pytest.raises(ValueError, match="rope_type"):
+        _rope_scaling_from_hf({"factor": 8.0})
+    assert _rope_scaling_from_hf(None) is None
+    assert _rope_scaling_from_hf({"rope_type": "default"}) is None
