@@ -446,3 +446,33 @@ class TestShuffleCombinator:
         assert len(batches) == 6
         got = sorted(int(v) for b in batches for v in np.asarray(b).ravel())
         assert got == list(range(24))
+
+
+def test_pack_sequences_fuzz():
+    """Invariants over random workloads: every token preserved in order,
+    rows exactly seq_len, segment ids 1..k with pad-0 suffix only."""
+    from dmlcloud_tpu.data import pack_sequences
+
+    rng = np.random.RandomState(11)
+    for trial in range(60):
+        seq_len = int(rng.randint(1, 33))
+        n = int(rng.randint(0, 12))
+        examples = [rng.randint(1, 1000, size=rng.randint(0, 3 * seq_len)) for _ in range(n)]
+        rows = list(pack_sequences(examples, seq_len))
+        got = [r["tokens"][r["segment_ids"] > 0] for r in rows]
+        want = [e for e in examples if e.size]
+        np.testing.assert_array_equal(
+            np.concatenate(got) if got else np.empty(0, np.int32),
+            np.concatenate(want) if want else np.empty(0, np.int32),
+        )
+        for r in rows:
+            toks, segs = r["tokens"], r["segment_ids"]
+            assert toks.shape == (seq_len,) and segs.shape == (seq_len,)
+            nz = np.flatnonzero(segs)
+            assert nz.size > 0  # no empty rows emitted
+            assert nz[-1] == nz.size - 1  # padding only as a suffix
+            ids = segs[: nz.size]
+            # 1..k, non-decreasing, no skips
+            assert ids[0] == 1 and (np.diff(ids) >= 0).all() and (np.diff(ids) <= 1).all()
+            # pad slots carry token 0
+            assert (toks[nz.size :] == 0).all()
