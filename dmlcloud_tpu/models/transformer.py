@@ -229,11 +229,19 @@ class Attention(nn.Module):
         if seg_info is not None:
             # Packed sequences (precomputed once in DecoderLM): rotary
             # positions restart at each segment's first token and attention
-            # is causal AND same-segment.
-            positions, mask = seg_info
+            # is causal AND same-segment (the flash kernel takes the raw ids,
+            # the dot path the precomputed mask).
+            positions, mask, seg_ids = seg_info
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
-            out = _dot_attention(q, k, v, mask=mask)
+            if cfg.attn_impl == "flash":
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(
+                    q, k, v, causal=True, window=cfg.sliding_window, segment_ids=seg_ids
+                )
+            else:
+                out = _dot_attention(q, k, v, mask=mask)
         elif cache is not None:
             # Autoregressive decode: write this call's K/V into the static-
             # shape cache at ``offset`` and attend over the whole buffer with
@@ -348,8 +356,8 @@ class DecoderLM(nn.Module):
         if segment_ids is not None:
             if cache is not None:
                 raise ValueError("segment_ids are a packed-training feature; unsupported in decode mode")
-            if cfg.attn_impl != "dot":
-                raise ValueError(f"segment_ids require attn_impl='dot' for now, got {cfg.attn_impl!r}")
+            if cfg.attn_impl == "ring":
+                raise ValueError("segment_ids are not supported with attn_impl='ring'")
             # computed ONCE here, shared by every layer: per-segment rotary
             # positions (restart at each segment's first token) and the
             # causal-AND-same-segment attention mask
@@ -357,11 +365,14 @@ class DecoderLM(nn.Module):
             same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B, T, S]
             seg_start = jnp.argmax(same, axis=-1)  # first index of own segment
             positions = jnp.arange(t)[None, :] - seg_start
-            mask = jnp.tril(jnp.ones((t, t), dtype=bool))[None] & same
-            if cfg.sliding_window is not None:
-                pos = jnp.arange(t)
-                mask = mask & _window_keep(pos[:, None], pos[None, :], cfg.sliding_window)[None]
-            seg_info = (positions, mask)
+            if cfg.attn_impl == "flash":
+                mask = None  # the flash kernels mask from the raw ids
+            else:
+                mask = jnp.tril(jnp.ones((t, t), dtype=bool))[None] & same
+                if cfg.sliding_window is not None:
+                    pos = jnp.arange(t)
+                    mask = mask & _window_keep(pos[:, None], pos[None, :], cfg.sliding_window)[None]
+            seg_info = (positions, mask, segment_ids)
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed"
         )(tokens)

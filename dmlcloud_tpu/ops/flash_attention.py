@@ -73,6 +73,22 @@ def _window_mask(s, q0, k0, q_block, block_k, causal: bool, window: int | None):
     return jnp.where(keep, s, _NEG_INF)
 
 
+#: sublane count for the kv-side segment-id layout ([B, _SUBLANES, S]): a
+#: (1, 8, block_k) block yields the [1, bk] ROW the mask comparison needs
+#: without an in-kernel transpose (the q side is lane-broadcast instead).
+_SUBLANES = 8
+
+
+def _segment_mask(s, seg_q_ref, seg_kv_ref):
+    """Mask cross-segment pairs: seg_q_ref [1, bq, _LANES] (lane-broadcast),
+    seg_kv_ref [1, _SUBLANES, bk] (sublane-broadcast)."""
+    if seg_q_ref is None:
+        return s
+    q_ids = seg_q_ref[0][:, :1]  # [bq, 1]
+    k_ids = seg_kv_ref[0][:1, :]  # [1, bk]
+    return jnp.where(q_ids == k_ids, s, _NEG_INF)
+
+
 def _maybe_when(cond, fn):
     """Run ``fn`` under ``pl.when`` unless the condition is statically True."""
     if cond is True:
@@ -106,8 +122,8 @@ def _q_skip_cond(qb, kb, block_q: int, k_block: int, causal: bool, window: int |
 
 
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, *rest, block_k: int, causal: bool, sm_scale: float, q_block: int,
-    num_kb: int, window: int | None
+    q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool, sm_scale: float, q_block: int,
+    num_kb: int, window: int | None, with_segments: bool = False
 ):
     # Grid (B*H, T/block_q, S/block_k) — K/V STREAM through the innermost
     # grid axis, so VMEM holds one [block_k, D] tile of each at a time (plus
@@ -120,6 +136,11 @@ def _attn_kernel(
     # optional lse_ref: [1, block_q, _LANES] — the FlashAttention-2 residual,
     # lane-broadcast (TPU tiling forbids (1, bq) blocks); scratch m/l are
     # lane-broadcast too, acc is [block_q, D] fp32.
+    if with_segments:
+        seg_q_ref, seg_kv_ref, *rest = rest
+    else:
+        seg_q_ref = seg_kv_ref = None
+    o_ref, *rest = rest
     if len(rest) == 4:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -142,6 +163,7 @@ def _attn_kernel(
             * sm_scale
         )  # [bq, bk] fp32
         s = _window_mask(s, qi * q_block, kb * block_k, q_block, block_k, causal, window)
+        s = _segment_mask(s, seg_q_ref, seg_kv_ref)
         m_prev = m_ref[:, :1]  # [bq, 1]
         l_prev = l_ref[:, :1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -175,9 +197,14 @@ def _attn_kernel(
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, block_k: int, causal: bool, sm_scale: float, q_block: int, num_kb: int, window: int | None
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_k: int, causal: bool, sm_scale: float, q_block: int, num_kb: int, window: int | None,
+    with_segments: bool = False
 ):
+    if with_segments:
+        seg_q_ref, seg_kv_ref, dq_ref, acc_ref = rest
+    else:
+        (dq_ref, acc_ref), seg_q_ref, seg_kv_ref = rest, None, None
     # Grid (B*H, T/block_q, S/block_k): K/V stream through the innermost grid
     # axis (same VMEM-bounded layout as the forward); dq accumulates in fp32
     # VMEM scratch across kb and is written once at the last K block.
@@ -201,6 +228,7 @@ def _dq_kernel(
             * sm_scale
         )  # [bq, bk]
         s = _window_mask(s, qi * q_block, kb * block_k, q_block, block_k, causal, window)
+        s = _segment_mask(s, seg_q_ref, seg_kv_ref)
         p = jnp.exp(s - lse)  # [bq, bk] fp32; masked entries underflow to 0
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
@@ -216,9 +244,14 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool, sm_scale: float, k_block: int, window: int | None
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q: int, causal: bool, sm_scale: float, k_block: int, window: int | None,
+    with_segments: bool = False
 ):
+    if with_segments:
+        seg_q_ref, seg_kv_ref, dk_ref, dv_ref = rest
+    else:
+        (dk_ref, dv_ref), seg_q_ref, seg_kv_ref = rest, None, None
     # grid (B*H, S/block_k, T/block_q): one KV block accumulates across the
     # innermost q-block dimension (dk/dv output blocks are revisited — they
     # stay resident in VMEM until kb advances). Q/dO/stats stream per step,
@@ -243,6 +276,7 @@ def _dkv_kernel(
             * sm_scale
         )  # [bq, bk]
         s = _window_mask(s, qb * block_q, kb * k_block, block_q, k_block, causal, window)
+        s = _segment_mask(s, seg_q_ref, seg_kv_ref)
         p = jnp.exp(s - lse)  # [bq, bk] fp32
         dv_ref[0] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -302,6 +336,7 @@ def flash_attention(
     interpret: bool | None = None,
     return_lse: bool = False,
     window: int | None = None,
+    segment_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0. Returns [B, T, H, D].
 
@@ -310,6 +345,12 @@ def flash_attention(
     (``q_pos - k_pos < W``, the Mistral convention). K/V blocks entirely
     older than the window are skipped in the grid AND their DMAs elided, so
     compute and HBM traffic scale with O(T·W) instead of O(T²).
+
+    ``segment_ids`` ([B, T] int32, requires T == S) masks cross-segment
+    pairs for packed-sequence training; composes with ``causal`` and
+    ``window``. The ids ride into the kernels lane-/sublane-broadcast
+    (extra ~(128+8)·4 bytes/token of HBM), and fully-masked rows follow
+    the same lse-floor self-healing as windowed calls.
 
     Sequence lengths must be multiples of the block sizes (pad upstream);
     block sizes auto-shrink for short sequences. Differentiable end-to-end in
@@ -346,58 +387,68 @@ def flash_attention(
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         window = int(window)
+    if segment_ids is not None:
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+        if segment_ids.shape != (b, t):
+            raise ValueError(f"segment_ids must be [B, T] == {(b, t)}, got {segment_ids.shape}")
+        if t != k.shape[1]:
+            raise ValueError("segment_ids require equal Q/KV sequence lengths (self-attention packing)")
     bq, bk = _auto_block(block_q, t), _auto_block(block_k, k.shape[1])
     if return_lse:
-        out, lse = _flash_lse(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret), window)
+        out, lse = _flash_lse(q, k, v, segment_ids, causal, float(sm_scale), bq, bk, bool(interpret), window)
         return out, lse.reshape(b, h, t).transpose(0, 2, 1)  # [B, T, H]
-    return _flash(q, k, v, causal, float(sm_scale), bq, bk, bool(interpret), window)
+    return _flash(q, k, v, segment_ids, causal, float(sm_scale), bq, bk, bool(interpret), window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret, window):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, window, seg)
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+def _flash_vjp_fwd(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret, window):
     out, lse = _flash_fwd_impl(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, seg, with_residuals=True
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window)
+    q, k, v, seg, out, lse = residuals
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window, seg
+    )
+    return dq, dk, dv, None  # integer segment ids carry no cotangent
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret, window):
     """(out, lse[B*H, T]) variant for blockwise/ring combiners."""
     return _flash_fwd_impl(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, seg, with_residuals=True
     )
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+def _flash_lse_vjp_fwd(q, k, v, seg, causal, sm_scale, block_q, block_k, interpret, window):
     out, lse = _flash_fwd_impl(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, with_residuals=True
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window, seg, with_residuals=True
     )
-    return (out, lse), (q, k, v, out, lse)
+    return (out, lse), (q, k, v, seg, out, lse)
 
 
 def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, window, residuals, gs):
     g_out, g_lse = gs
-    q, k, v, out, lse = residuals
+    q, k, v, seg, out, lse = residuals
     # d lse_i / d s_ij = p_ij, so the lse cotangent enters the existing
     # backward as ds += p * g_lse — algebraically a shift of the delta term:
     # ds = p * (dp - (delta - g_lse)). Zero kernel changes needed.
-    return _flash_bwd_impl(
-        q, k, v, out, lse, g_out, causal, sm_scale, block_q, block_k, interpret, window,
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, g_out, causal, sm_scale, block_q, block_k, interpret, window, seg,
         lse_cotangent=g_lse,
     )
+    return dq, dk, dv, None
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -461,7 +512,17 @@ def _clamp_q_stream(qb, kb, block_q: int, block_k: int, causal: bool, window: in
     return jnp.maximum(qb, lo)
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, window=None, with_residuals=False):
+def _seg_layouts(seg, b, t, s):
+    """[B, T] segment ids -> the two kernel layouts (see _SUBLANES note)."""
+    seg = jnp.asarray(seg, jnp.int32)
+    seg_q3 = jnp.broadcast_to(seg[:, :, None], (b, t, _LANES))
+    seg_kv3 = jnp.broadcast_to(seg[:, None, :], (b, _SUBLANES, s))
+    return seg_q3, seg_kv3
+
+
+def _flash_fwd_impl(
+    q, k, v, causal, sm_scale, block_q, block_k, interpret, window=None, seg=None, with_residuals=False
+):
     if _VMEM is None:
         raise RuntimeError(
             "flash_attention needs jax.experimental.pallas.tpu (VMEM scratch accumulators); "
@@ -482,12 +543,30 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, wind
 
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q,
-        num_kb=num_kb, window=window,
+        num_kb=num_kb, window=window, with_segments=seg is not None,
     )
     vmem = {"memory_space": _VMEM}
 
     def kv_block(bh, qi, kb):
         return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),
+        pl.BlockSpec((1, block_k, d), kv_block, **vmem),
+        pl.BlockSpec((1, block_k, d), kv_block, **vmem),
+    ]
+    operands = [qt, kt, vt]
+    if seg is not None:
+        seg_q3, seg_kv3 = _seg_layouts(seg, b, t, s)
+        in_specs.append(pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh // h, qi, 0), **vmem))
+        in_specs.append(
+            pl.BlockSpec(
+                (1, _SUBLANES, block_k),
+                lambda bh, qi, kb: (bh // h, 0, _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb)),
+                **vmem,
+            )
+        )
+        operands += [seg_q3, seg_kv3]
 
     out_shape = [jax.ShapeDtypeStruct((b * h, t, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem)]
@@ -498,11 +577,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, wind
         kernel,
         out_shape=out_shape,
         grid=(b * h, t // block_q, num_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),
-            pl.BlockSpec((1, block_k, d), kv_block, **vmem),
-            pl.BlockSpec((1, block_k, d), kv_block, **vmem),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max m
@@ -510,7 +585,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, wind
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
 
     out = results[0].reshape(b, h, t, d).transpose(0, 2, 1, 3)
     if with_residuals:
@@ -521,7 +596,8 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret, wind
 
 
 def _flash_bwd_impl(
-    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window=None, lse_cotangent=None
+    q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret, window=None, seg=None,
+    lse_cotangent=None,
 ):
     b, t, h, d = q.shape
     s, kh = k.shape[1], k.shape[2]
@@ -548,54 +624,79 @@ def _flash_bwd_impl(
         return (kv_index(bh), _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb), 0)
 
     num_kb = s // block_k
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # q
+        pl.BlockSpec((1, block_k, d), kv_block, **vmem),  # k
+        pl.BlockSpec((1, block_k, d), kv_block, **vmem),  # v
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # dO
+        pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # lse
+        pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # delta
+    ]
+    dq_operands = [qt, kt, vt, dot, lse3, delta3]
+    if seg is not None:
+        seg_q3, seg_kv3 = _seg_layouts(seg, b, t, s)
+        dq_in_specs.append(pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh // h, qi, 0), **vmem))
+        dq_in_specs.append(
+            pl.BlockSpec(
+                (1, _SUBLANES, block_k),
+                lambda bh, qi, kb: (bh // h, 0, _clamp_kv_stream(kb, qi, block_q, block_k, causal, window, num_kb)),
+                **vmem,
+            )
+        )
+        dq_operands += [seg_q3, seg_kv3]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale, q_block=block_q,
-            num_kb=num_kb, window=window,
+            num_kb=num_kb, window=window, with_segments=seg is not None,
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         grid=(b * h, t // block_q, num_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # q
-            pl.BlockSpec((1, block_k, d), kv_block, **vmem),  # k
-            pl.BlockSpec((1, block_k, d), kv_block, **vmem),  # v
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # dO
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # lse
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, qi, kb: (bh, qi, 0), **vmem),  # delta
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0), **vmem),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],  # dq accumulator
         interpret=interpret,
-    )(qt, kt, vt, dot, lse3, delta3)
+    )(*dq_operands)
 
     # per-query-head dK/dV; group-summed below for GQA. 3D grid: the q-block
     # axis is innermost so dk/dv output blocks accumulate in VMEM.
     def q_stream(qb, kb):
         return _clamp_q_stream(qb, kb, block_q, block_k, causal, window)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # q
+        pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # k
+        pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # v
+        pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # dO
+        pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # lse
+        pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # delta
+    ]
+    dkv_operands = [qt, kt, vt, dot, lse3, delta3]
+    if seg is not None:
+        seg_q3, seg_kv3 = _seg_layouts(seg, b, t, s)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh // h, q_stream(qb, kb), 0), **vmem)
+        )
+        dkv_in_specs.append(
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda bh, kb, qb: (bh // h, 0, kb), **vmem)
+        )
+        dkv_operands += [seg_q3, seg_kv3]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale, k_block=block_k, window=window
+            _dkv_kernel, block_q=block_q, causal=causal, sm_scale=sm_scale, k_block=block_k,
+            window=window, with_segments=seg is not None,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
         ],
         grid=(b * h, s // block_k, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # q
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # k
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (kv_index(bh, kb), kb, 0), **vmem),  # v
-            pl.BlockSpec((1, block_q, d), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # dO
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # lse
-            pl.BlockSpec((1, block_q, _LANES), lambda bh, kb, qb: (bh, q_stream(qb, kb), 0), **vmem),  # delta
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0), **vmem),
             pl.BlockSpec((1, block_k, d), lambda bh, kb, qb: (bh, kb, 0), **vmem),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse3, delta3)
+    )(*dkv_operands)
 
     dq = dq.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     dk = dk_h.reshape(b, kh, group, s, d).sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)

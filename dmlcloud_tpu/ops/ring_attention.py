@@ -178,7 +178,7 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
 
     for step in range(steps_needed):
         if step == 0:
-            out_b, lse_b = _flash_lse(q, kb, vb, True, float(sm_scale), bq, bk, bool(interpret), window)
+            out_b, lse_b = _flash_lse(q, kb, vb, None, True, float(sm_scale), bq, bk, bool(interpret), window)
             lse_b = to_bth(lse_b)
         else:
             # a device holds the block `step` behind it iff idx >= step;
@@ -186,7 +186,7 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
             w_eff = window - step * tl  # static relative cutoff in local coords
 
             def behind(q, kb, vb):
-                o, l = _flash_lse(q, kb, vb, False, float(sm_scale), bq, bk, bool(interpret), w_eff)
+                o, l = _flash_lse(q, kb, vb, None, False, float(sm_scale), bq, bk, bool(interpret), w_eff)
                 return o, to_bth(l)
 
             def ahead(q, kb, vb):

@@ -138,8 +138,8 @@ def main():
     )
     args = parser.parse_args()
 
-    if args.pack and args.attn != "dot":
-        parser.error("--pack (segment_ids) currently requires --attn dot")
+    if args.pack and args.attn == "ring":
+        parser.error("--pack (segment_ids) is not supported with --attn ring")
 
     init_auto(verbose=True)
 

@@ -61,12 +61,15 @@ def test_packed_loss_matches_unpacked(packed_setup):
     assert abs(float(loss_packed) - want) < 1e-5
 
 
-def test_segment_ids_reject_non_dot():
-    cfg = _cfg(attn_impl="flash")
+def test_segment_ids_reject_ring():
+    from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    cfg = _cfg(attn_impl="ring", mesh=mesh)
     model = DecoderLM(cfg)
     row = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), row)["params"]
-    with pytest.raises(ValueError, match="attn_impl"):
+    with pytest.raises(ValueError, match="ring"):
         model.apply({"params": params}, row, segment_ids=jnp.ones((1, 8), jnp.int32))
 
 
@@ -154,3 +157,37 @@ class TestSlidingWindow:
         out_dot = DecoderLM(cfg_dot).apply({"params": params}, toks)
         out_ring = DecoderLM(cfg_ring).apply({"params": params}, toks)
         np.testing.assert_allclose(np.asarray(out_dot), np.asarray(out_ring), atol=2e-4, rtol=2e-4)
+
+
+def test_packed_flash_matches_packed_dot():
+    """attn_impl='flash' now honors segment_ids: logits equal the dot path."""
+    cfg_dot = _cfg(max_seq_len=64)
+    cfg_flash = _cfg(max_seq_len=64, attn_impl="flash")
+    rng = np.random.RandomState(9)
+    row = rng.randint(1, 37, size=(2, 64)).astype(np.int32)
+    segs = np.repeat(np.arange(1, 9)[None], 2, 0).repeat(8, axis=1).astype(np.int32)  # 8 segs x 8
+    params = DecoderLM(cfg_dot).init(jax.random.PRNGKey(0), jnp.asarray(row))["params"]
+    out_dot = DecoderLM(cfg_dot).apply(
+        {"params": params}, jnp.asarray(row), segment_ids=jnp.asarray(segs)
+    )
+    out_flash = DecoderLM(cfg_flash).apply(
+        {"params": params}, jnp.asarray(row), segment_ids=jnp.asarray(segs)
+    )
+    np.testing.assert_allclose(np.asarray(out_dot), np.asarray(out_flash), atol=2e-4, rtol=2e-4)
+
+
+def test_packed_flash_grads_flow():
+    cfg = _cfg(max_seq_len=64, attn_impl="flash")
+    rng = np.random.RandomState(10)
+    row = rng.randint(1, 37, size=(1, 64)).astype(np.int32)
+    segs = np.concatenate([np.full(40, 1), np.full(24, 2)])[None].astype(np.int32)
+    model = DecoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(1), jnp.asarray(row))["params"]
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, jnp.asarray(row), segment_ids=jnp.asarray(segs))
+        return lm_loss(logits, jnp.asarray(row), segment_ids=jnp.asarray(segs))
+
+    grads = jax.grad(loss_fn)(params)
+    gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree_util.tree_leaves(grads))
+    assert np.isfinite(gnorm) and gnorm > 0
