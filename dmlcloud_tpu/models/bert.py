@@ -3,8 +3,9 @@ sharded data" config family, TPU-first:
 
 - Word + learned-position + segment embeddings, pre-LN encoder core
   (models/encoder.py), bf16 matmuls / fp32 norms.
-- Padding handled as an additive softmax bias (no dynamic shapes — XLA
-  compiles one program for all mask patterns).
+- Padding handled with static shapes (one compiled program for all mask
+  patterns): an additive softmax bias on the dot path, kernel segment ids
+  on the flash path.
 - MLM head tied to the word embedding (one [D, V] matmul on the MXU);
   ``ignore_index=-100`` label convention in :func:`mlm_loss`.
 - Sequence classification via a tanh pooler over the [CLS] position.
@@ -23,7 +24,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import optax
 
-from .encoder import AddLearnedPositions, EncoderConfig, TransformerEncoder, padding_mask_bias
+from .encoder import AddLearnedPositions, EncoderConfig, TransformerEncoder
 
 IGNORE_INDEX = -100
 
@@ -39,6 +40,7 @@ class BertConfig:
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
+    attn_impl: str = "dot"  # 'dot' | 'flash' (padding masks ride both paths)
 
     @property
     def encoder(self) -> EncoderConfig:
@@ -50,6 +52,7 @@ class BertConfig:
             dtype=self.dtype,
             causal=False,
             dropout_rate=self.dropout_rate,
+            attn_impl=self.attn_impl,
         )
 
 
@@ -82,8 +85,11 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, tokens, attention_mask=None, token_type_ids=None, train: bool = False):
         x = BertEmbeddings(self.cfg, name="embeddings")(tokens, token_type_ids)
-        bias = padding_mask_bias(attention_mask) if attention_mask is not None else None
-        return TransformerEncoder(self.cfg.encoder, name="encoder")(x, bias, train=train)
+        # raw keep-mask: the flash path turns it into kernel segment ids,
+        # the dot path into the additive bias (padding_mask_bias)
+        return TransformerEncoder(self.cfg.encoder, name="encoder")(
+            x, train=train, keep_mask=attention_mask
+        )
 
 
 class BertForMaskedLM(nn.Module):

@@ -42,10 +42,10 @@ class EncoderConfig:
     # recompute each block in the backward pass (gradient rematerialisation):
     # O(1) blocks of live activation memory for ~1/3 more FLOPs
     remat: bool = False
-    # 'dot' (einsum softmax) | 'flash' (fused Pallas kernel; unmasked
-    # sequences only — padded batches fall back to 'dot' per call). Sequence
-    # length must be a multiple of 64 for 'flash' (ViT-B/L's 197 tokens is
-    # not; pad or keep 'dot' there).
+    # 'dot' (einsum softmax) | 'flash' (fused Pallas kernel; {0,1} padding
+    # keep-masks ride it as kernel segment ids, arbitrary additive biases
+    # fall back to 'dot' per call). Sequence length must be a multiple of 64
+    # for 'flash' (ViT-B/L's 197 tokens is not; pad or keep 'dot' there).
     attn_impl: str = "dot"
 
     def __post_init__(self):
@@ -105,7 +105,7 @@ class EncoderAttention(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, mask_bias=None):
+    def __call__(self, x, mask_bias=None, keep_mask=None):
         cfg = self.cfg
         b, t, _ = x.shape
         dense = lambda name: nn.DenseGeneral(
@@ -119,14 +119,22 @@ class EncoderAttention(nn.Module):
         k = dense("k_proj")(x)
         v = dense("v_proj")(x)
 
+        if mask_bias is not None and keep_mask is not None:
+            # a silent ignore would let pad keys leak into a custom-bias call
+            raise ValueError("pass either mask_bias or keep_mask, not both")
         if cfg.attn_impl == "flash" and mask_bias is None:
-            # fused Pallas path (ops/flash_attention.py); padding masks need
-            # the additive-bias path below, so BERT-style padded batches fall
-            # back automatically while ViT/CLIP towers (no mask) fuse
+            # fused Pallas path (ops/flash_attention.py). A {0,1} keep-mask
+            # rides as kernel segment ids: real tokens attend real tokens
+            # only. (Pad positions attend pads instead of everything — their
+            # outputs differ from the bias path but are masked downstream by
+            # pooling/loss anyway.) Arbitrary additive biases still fall back.
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=cfg.causal)
+            seg = keep_mask.astype(jnp.int32) if keep_mask is not None else None
+            out = flash_attention(q, k, v, causal=cfg.causal, segment_ids=seg)
         else:
+            if mask_bias is None and keep_mask is not None:
+                mask_bias = padding_mask_bias(keep_mask)
             scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
             scores = scores / jnp.sqrt(cfg.head_dim)
             if cfg.causal:
@@ -160,13 +168,15 @@ class EncoderBlock(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, mask_bias=None, train: bool = False):
+    def __call__(self, x, mask_bias=None, train: bool = False, keep_mask=None):
         cfg = self.cfg
         norm = lambda name: nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name=name
         )
         drop = lambda y: nn.Dropout(cfg.dropout_rate)(y, deterministic=not train)
-        x = x + drop(EncoderAttention(cfg, name="attn")(norm("attn_norm")(x).astype(cfg.dtype), mask_bias))
+        x = x + drop(
+            EncoderAttention(cfg, name="attn")(norm("attn_norm")(x).astype(cfg.dtype), mask_bias, keep_mask)
+        )
         x = x + drop(EncoderMLP(cfg, name="mlp")(norm("mlp_norm")(x).astype(cfg.dtype)))
         return x
 
@@ -180,7 +190,7 @@ class TransformerEncoder(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, mask_bias=None, train: bool = False):
+    def __call__(self, x, mask_bias=None, train: bool = False, keep_mask=None):
         cfg = self.cfg
         block_cls = EncoderBlock
         if cfg.remat:
@@ -188,7 +198,7 @@ class TransformerEncoder(nn.Module):
             # dropout branch, so it must not be traced through remat
             block_cls = nn.remat(EncoderBlock, prevent_cse=True, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer_{i}")(x, mask_bias, train)
+            x = block_cls(cfg, name=f"layer_{i}")(x, mask_bias, train, keep_mask)
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=jnp.float32, param_dtype=jnp.float32, name="final_norm"
         )(x)

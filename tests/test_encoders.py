@@ -281,3 +281,30 @@ def test_invalid_attn_impl_rejected():
         EncoderConfig(attn_impl="Flash")
     with pytest.raises(ValueError, match="attn_impl"):
         TransformerConfig(attn_impl="pallas")
+
+
+def test_bert_padded_flash_matches_dot_on_real_positions():
+    """A padded batch on the flash path (keep-mask as kernel segment ids)
+    must match the dot/bias path at every REAL position (pad outputs differ
+    by design and are masked downstream)."""
+    from dmlcloud_tpu.models.bert import BertConfig, BertEncoder
+
+    kw = dict(vocab_size=61, hidden_dim=32, num_heads=2, mlp_dim=64,
+              num_layers=2, max_seq_len=64, dtype=jnp.float32)
+    cfg_dot = BertConfig(**kw, attn_impl="dot")
+    cfg_flash = BertConfig(**kw, attn_impl="flash")
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 61, size=(2, 64)).astype(np.int32)
+    mask = np.ones((2, 64), np.int32)
+    mask[0, 50:] = 0
+    mask[1, 33:] = 0
+
+    model_dot, model_flash = BertEncoder(cfg_dot), BertEncoder(cfg_flash)
+    params = model_dot.init(jax.random.PRNGKey(0), jnp.asarray(tokens))["params"]
+    out_dot = model_dot.apply({"params": params}, jnp.asarray(tokens), jnp.asarray(mask))
+    out_flash = model_flash.apply({"params": params}, jnp.asarray(tokens), jnp.asarray(mask))
+    for r in range(2):
+        real = mask[r].astype(bool)
+        np.testing.assert_allclose(
+            np.asarray(out_dot)[r][real], np.asarray(out_flash)[r][real], atol=2e-4, rtol=2e-4
+        )
