@@ -64,6 +64,7 @@ def _generate_compiled(
     model: DecoderLM,
     params,
     prompt: jnp.ndarray,
+    pad_len: jnp.ndarray | None,
     rng: jax.Array,
     max_new_tokens: int,
     temperature: float,
@@ -77,7 +78,9 @@ def _generate_compiled(
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
 
     # Prefill: one pass over the whole prompt fills cache slots [0, t).
-    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0)
+    # Left padding means every row's LAST slot is real, so sampling reads
+    # logits[:, -1] and decode write offsets stay uniform across rows.
+    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len)
     last = logits[:, -1]  # [B, V]
 
     def sample_next(prev_logits, rng, done):
@@ -89,7 +92,9 @@ def _generate_compiled(
         cache, prev_logits, rng, done = carry
         rng, sub = jax.random.split(rng)
         tok, done = sample_next(prev_logits, sub, done)
-        logits, cache = model.apply({"params": params}, tok[:, None], cache=cache, offset=t + i)
+        logits, cache = model.apply(
+            {"params": params}, tok[:, None], cache=cache, offset=t + i, pad_len=pad_len
+        )
         return (cache, logits[:, 0], rng, done), tok
 
     # scan N-1 decode steps; the Nth token needs only a sample, not another
@@ -119,12 +124,17 @@ def generate(
     rng: jax.Array | None = None,
     eos_id: int = -1,
     pad_id: int = 0,
+    prompt_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T] int32
-    (uniform prompt length across the batch). Greedy when
-    ``temperature == 0``; otherwise temperature sampling with optional
-    ``top_k`` / nucleus ``top_p`` truncation. Rows that emit ``eos_id``
-    keep emitting ``pad_id``. Returns [B, max_new_tokens] int32.
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, T] int32.
+    Greedy when ``temperature == 0``; otherwise temperature sampling with
+    optional ``top_k`` / nucleus ``top_p`` truncation. Rows that emit
+    ``eos_id`` keep emitting ``pad_id``. Returns [B, max_new_tokens] int32.
+
+    Ragged prompts: LEFT-pad them to a common length and pass
+    ``prompt_mask`` ([B, T] {0,1}, zeros first) — pad slots are masked out
+    of attention and rotary positions count from each row's first real
+    token, so every row decodes exactly as it would unpadded.
 
     The whole generation — prefill + scan over decode steps — is one
     compiled program; recompiles happen only when shapes or the static
@@ -133,10 +143,25 @@ def generate(
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t = prompt.shape
     _check_len(model, t, max_new_tokens)
+    pad_len = None
+    if prompt_mask is not None:
+        import numpy as np
+
+        if jnp.shape(prompt_mask) != (b, t):
+            raise ValueError(f"prompt_mask must be [B, T] == {(b, t)}, got {jnp.shape(prompt_mask)}")
+        if not isinstance(prompt_mask, jax.core.Tracer):
+            # any CONCRETE mask (numpy or jax array) gets the eager
+            # left-padding check — a right-padded mask would silently
+            # generate garbage otherwise
+            host = np.asarray(prompt_mask).astype(np.int32)
+            if not (np.diff(host, axis=1) >= 0).all():
+                raise ValueError("prompt_mask must be LEFT padding: zeros then ones per row")
+        prompt_mask = jnp.asarray(prompt_mask, jnp.int32)
+        pad_len = (t - jnp.sum(prompt_mask, axis=1)).astype(jnp.int32)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_compiled(
-        model, params, prompt, rng,
+        model, params, prompt, pad_len, rng,
         int(max_new_tokens), float(temperature), int(top_k), float(top_p), int(eos_id), int(pad_id),
     )
 

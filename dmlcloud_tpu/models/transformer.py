@@ -211,7 +211,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None):
         cfg = self.cfg
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
@@ -221,9 +221,15 @@ class Attention(nn.Module):
         k = dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x)
         v = dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x)
 
-        if seg_info is None:  # packed rows carry per-segment positions instead
+        if seg_info is None and decode_pad is None:
             q = apply_rope(q, cos, sin, offset=offset)
             k = apply_rope(k, cos, sin, offset=offset)
+        elif decode_pad is not None:
+            # left-padded ragged prompts: per-row positions (real tokens
+            # count from 0 at each row's first real slot)
+            _, positions = decode_pad
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
 
         new_cache = None
         if seg_info is not None:
@@ -255,6 +261,10 @@ class Attention(nn.Module):
             mask = kv_pos <= q_pos  # causal AND only written slots
             if cfg.sliding_window is not None:
                 mask = mask & _window_keep(q_pos, kv_pos, cfg.sliding_window)
+            if decode_pad is not None:
+                # left-pad slots hold garbage K/V — mask them per row
+                pad_len, _ = decode_pad
+                mask = mask[None] & (kv_pos[None] >= pad_len[:, None, None])
             out = _dot_attention(q, k, v, mask=mask)
             new_cache = {"k": k, "v": v}
         elif cfg.attn_impl == "flash":
@@ -309,12 +319,12 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None):
         cfg = self.cfg
         new_cache = None
         if cache is not None:
             attn_out, new_cache = Attention(cfg, name="attn")(
-                RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset
+                RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset, decode_pad=decode_pad
             )
             x = x + attn_out
         else:
@@ -350,8 +360,14 @@ class DecoderLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, cache=None, offset=0, segment_ids=None):
+    def __call__(self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None):
         cfg = self.cfg
+        if pad_len is not None and cache is None:
+            raise ValueError("pad_len (left-padded ragged prompts) is a decode-mode feature")
+        decode_pad = None
+        if pad_len is not None:
+            positions = jnp.maximum(jnp.arange(tokens.shape[1])[None, :] + offset - pad_len[:, None], 0)
+            decode_pad = (pad_len, positions)
         seg_info = None
         if segment_ids is not None:
             if cache is not None:
@@ -396,7 +412,7 @@ class DecoderLM(nn.Module):
             name = f"layer_{i}"
             if cache is not None:
                 x, new_cache[name] = DecoderBlock(cfg, use_moe=use_moe, name=name)(
-                    x, cos, sin, cache=cache[name], offset=offset
+                    x, cos, sin, cache=cache[name], offset=offset, decode_pad=decode_pad
                 )
                 x = constrain(x)
             else:

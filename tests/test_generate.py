@@ -238,3 +238,61 @@ class TestBeamSearch:
         misses = _beam_search_compiled._cache_size()
         beam_search(model, params, prompt, 3, num_beams=2, length_penalty=1.3)
         assert _beam_search_compiled._cache_size() == misses
+
+
+class TestRaggedPrompts:
+    def test_left_padded_rows_match_unpadded(self):
+        """Each left-padded row must decode exactly as its unpadded self."""
+        cfg = _tiny_cfg()
+        model, params, _ = _init(cfg)
+        rng = np.random.RandomState(11)
+        p1 = rng.randint(1, 61, size=5)
+        p2 = rng.randint(1, 61, size=9)
+        t = 9
+        batch = np.zeros((2, t), np.int32)
+        mask = np.zeros((2, t), np.int32)
+        batch[0, t - 5 :], mask[0, t - 5 :] = p1, 1
+        batch[1, :], mask[1, :] = p2, 1
+
+        got = generate(model, params, jnp.asarray(batch), 6, prompt_mask=jnp.asarray(mask))
+        want1 = generate(model, params, jnp.asarray(p1[None]), 6)
+        want2 = generate(model, params, jnp.asarray(p2[None]), 6)
+        np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(want1)[0])
+        np.testing.assert_array_equal(np.asarray(got)[1], np.asarray(want2)[0])
+
+    def test_windowed_ragged(self):
+        cfg = _tiny_cfg(sliding_window=4)
+        model, params, _ = _init(cfg)
+        rng = np.random.RandomState(12)
+        p1 = rng.randint(1, 61, size=3)
+        p2 = rng.randint(1, 61, size=7)
+        t = 7
+        batch = np.zeros((2, t), np.int32)
+        mask = np.zeros((2, t), np.int32)
+        batch[0, t - 3 :], mask[0, t - 3 :] = p1, 1
+        batch[1, :], mask[1, :] = p2, 1
+        got = generate(model, params, jnp.asarray(batch), 5, prompt_mask=jnp.asarray(mask))
+        want1 = generate(model, params, jnp.asarray(p1[None]), 5)
+        np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(want1)[0])
+
+    def test_right_padding_rejected(self):
+        cfg = _tiny_cfg()
+        model, params, prompt = _init(cfg)
+        mask = np.ones((2, 7), np.int32)
+        mask[:, -2:] = 0  # right padding
+        with pytest.raises(ValueError, match="LEFT"):
+            generate(model, params, prompt, 4, prompt_mask=mask)
+
+    def test_right_padding_rejected_for_jax_arrays_too(self):
+        cfg = _tiny_cfg()
+        model, params, prompt = _init(cfg)
+        mask = np.ones((2, 7), np.int32)
+        mask[:, -2:] = 0
+        with pytest.raises(ValueError, match="LEFT"):
+            generate(model, params, prompt, 4, prompt_mask=jnp.asarray(mask))
+
+    def test_bad_mask_shape_message(self):
+        cfg = _tiny_cfg()
+        model, params, prompt = _init(cfg)
+        with pytest.raises(ValueError, match=r"\[B, T\]"):
+            generate(model, params, prompt, 4, prompt_mask=np.ones(7, np.int32))
