@@ -136,12 +136,19 @@ def main():
     if args.sample > 0 and runtime.world_size() == 1:
         from dmlcloud_tpu.models.generate import generate
 
-        # prompts drawn from the TRAINING corpus distribution (same seed ->
-        # same byte-chain transition table)
-        prompt = np.stack([d[:8] for d in byte_corpus(2, cfg.vocab_size, seed=0)])
-        out = generate(model, stage.state.params, prompt, max_new_tokens=args.sample)
-        for row, cont in zip(prompt.tolist(), np.asarray(out).tolist()):
-            print(f"prompt {row} -> {cont}")
+        # ragged prompts drawn from the TRAINING corpus distribution (same
+        # seed -> same byte-chain transition table), LEFT-padded to one width
+        docs = byte_corpus(2, cfg.vocab_size, seed=0)
+        pieces = [docs[0][:5], docs[1][:9]]
+        width = max(len(p) for p in pieces)
+        prompt = np.zeros((len(pieces), width), np.int32)
+        mask = np.zeros((len(pieces), width), np.int32)
+        for r, p in enumerate(pieces):
+            prompt[r, width - len(p) :] = p
+            mask[r, width - len(p) :] = 1
+        out = generate(model, stage.state.params, prompt, max_new_tokens=args.sample, prompt_mask=mask)
+        for p, cont in zip(pieces, np.asarray(out).tolist()):
+            print(f"prompt {p.tolist()} -> {cont}")
 
     if args.export:
         if runtime.world_size() > 1:
