@@ -105,6 +105,24 @@ def _generate_compiled(
     return jnp.concatenate([tokens, final_tok[None]], axis=0).T  # [B, max_new_tokens]
 
 
+def _pad_len_from_mask(prompt_mask, b: int, t: int):
+    """[B, T] {0,1} LEFT-pad keep-mask -> per-row pad counts [B] int32
+    (None passthrough). Concrete masks are validated eagerly — a
+    right-padded mask would silently generate garbage."""
+    if prompt_mask is None:
+        return None
+    import numpy as np
+
+    if jnp.shape(prompt_mask) != (b, t):
+        raise ValueError(f"prompt_mask must be [B, T] == {(b, t)}, got {jnp.shape(prompt_mask)}")
+    if not isinstance(prompt_mask, jax.core.Tracer):
+        host = np.asarray(prompt_mask).astype(np.int32)
+        if not (np.diff(host, axis=1) >= 0).all():
+            raise ValueError("prompt_mask must be LEFT padding: zeros then ones per row")
+    prompt_mask = jnp.asarray(prompt_mask, jnp.int32)
+    return (t - jnp.sum(prompt_mask, axis=1)).astype(jnp.int32)
+
+
 def _check_len(model: DecoderLM, t: int, max_new_tokens: int) -> None:
     if t + max_new_tokens > model.cfg.max_seq_len:
         raise ValueError(
@@ -143,21 +161,7 @@ def generate(
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t = prompt.shape
     _check_len(model, t, max_new_tokens)
-    pad_len = None
-    if prompt_mask is not None:
-        import numpy as np
-
-        if jnp.shape(prompt_mask) != (b, t):
-            raise ValueError(f"prompt_mask must be [B, T] == {(b, t)}, got {jnp.shape(prompt_mask)}")
-        if not isinstance(prompt_mask, jax.core.Tracer):
-            # any CONCRETE mask (numpy or jax array) gets the eager
-            # left-padding check — a right-padded mask would silently
-            # generate garbage otherwise
-            host = np.asarray(prompt_mask).astype(np.int32)
-            if not (np.diff(host, axis=1) >= 0).all():
-                raise ValueError("prompt_mask must be LEFT padding: zeros then ones per row")
-        prompt_mask = jnp.asarray(prompt_mask, jnp.int32)
-        pad_len = (t - jnp.sum(prompt_mask, axis=1)).astype(jnp.int32)
+    pad_len = _pad_len_from_mask(prompt_mask, b, t)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_compiled(
@@ -173,6 +177,7 @@ def _beam_search_compiled(
     model: DecoderLM,
     params,
     prompt: jnp.ndarray,
+    pad_len: jnp.ndarray | None,
     length_penalty: jnp.ndarray,
     max_new_tokens: int,
     num_beams: int,
@@ -186,8 +191,9 @@ def _beam_search_compiled(
 
     # Prefill once per batch row, then tile the cache across beams.
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
-    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0)
+    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len)
     cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, k, axis=0), cache)  # [B*K, ...]
+    pad_len_k = None if pad_len is None else jnp.repeat(pad_len, k, axis=0)  # beam-tiled
     first_lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
 
     # Step 0: the K best first tokens seed the beams.
@@ -201,7 +207,8 @@ def _beam_search_compiled(
         cache, tokens, scores, lengths, finished, last_tok = carry
         # last_tok was emitted at position t + i - 1; its K/V lands there
         logits, cache = model.apply(
-            {"params": params}, last_tok.reshape(b * k, 1), cache=cache, offset=t + i - 1
+            {"params": params}, last_tok.reshape(b * k, 1), cache=cache, offset=t + i - 1,
+            pad_len=pad_len_k,
         )
         lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)).reshape(b, k, v)
         # finished beams may only extend with pad at no cost; everything else
@@ -255,13 +262,15 @@ def beam_search(
     length_penalty: float = 1.0,
     eos_id: int = -1,
     pad_id: int = 0,
+    prompt_mask: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Beam-search decoding: returns ``(tokens [B, max_new_tokens],
     scores [B])`` where scores are length-normalised sequence log-probs
     (``sum logp / len**length_penalty``). Beams that emit ``eos_id`` freeze
     and pad. Like :func:`generate`, the whole search — prefill, scan, beam
     reordering (cache gathered along the beam axis) — is ONE compiled
-    program."""
+    program. Ragged prompts work like :func:`generate`: LEFT-pad and pass
+    ``prompt_mask``."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, t = prompt.shape
     _check_len(model, t, max_new_tokens)
@@ -273,9 +282,10 @@ def beam_search(
         # pad_id is a scatter index into the finished-beam cost vector; an
         # out-of-range value would silently corrupt eos handling under jit
         raise ValueError(f"pad_id must be in [0, vocab_size), got {pad_id}")
+    pad_len = _pad_len_from_mask(prompt_mask, b, t)
     # length_penalty rides as a traced operand: sweeping it must not
     # recompile the whole search
     return _beam_search_compiled(
-        model, params, prompt, jnp.float32(length_penalty), int(max_new_tokens),
+        model, params, prompt, pad_len, jnp.float32(length_penalty), int(max_new_tokens),
         int(num_beams), int(eos_id), int(pad_id),
     )

@@ -296,3 +296,25 @@ class TestRaggedPrompts:
         model, params, prompt = _init(cfg)
         with pytest.raises(ValueError, match=r"\[B, T\]"):
             generate(model, params, prompt, 4, prompt_mask=np.ones(7, np.int32))
+
+
+def test_ragged_beam_rows_match_unpadded():
+    from dmlcloud_tpu.models.generate import beam_search
+
+    cfg = _tiny_cfg()
+    model, params, _ = _init(cfg)
+    rng = np.random.RandomState(13)
+    p1 = rng.randint(1, 61, size=4)
+    p2 = rng.randint(1, 61, size=8)
+    t = 8
+    batch, mask = np.zeros((2, t), np.int32), np.zeros((2, t), np.int32)
+    batch[0, t - 4 :], mask[0, t - 4 :] = p1, 1
+    batch[1], mask[1] = p2, 1
+
+    got, scores = beam_search(model, params, jnp.asarray(batch), 5, num_beams=3,
+                              prompt_mask=jnp.asarray(mask))
+    want1, s1 = beam_search(model, params, jnp.asarray(p1[None]), 5, num_beams=3)
+    want2, s2 = beam_search(model, params, jnp.asarray(p2[None]), 5, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(want1)[0])
+    np.testing.assert_array_equal(np.asarray(got)[1], np.asarray(want2)[0])
+    np.testing.assert_allclose(np.asarray(scores), [float(s1[0]), float(s2[0])], atol=1e-5)
