@@ -180,6 +180,15 @@ class DataPipeline(_DatasetBase):
     def map(self, fn: Callable[[Any], Any]) -> "DataPipeline":
         return self._chain(lambda it, _e: (fn(x) for x in it), self._length_fn)
 
+    def pack(self, seq_len: int, *, split_long: bool = True) -> "DataPipeline":
+        """Pack a stream of variable-length token sequences into fixed
+        ``seq_len`` rows of ``{"tokens", "segment_ids"}`` (see
+        :func:`pack_sequences`) — compose as
+        ``pipeline.shuffle(...).pack(2048).batch(8)``."""
+        if seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        return self._chain(lambda it, _e: _pack_sequences_iter(it, seq_len, split_long))
+
     def shuffle(self, buffer_size: int, seed: int = 0) -> "DataPipeline":
         """Streaming shuffle through a ``buffer_size`` reservoir (the
         tf.data idiom): each yield swaps a random buffer slot with the next

@@ -476,3 +476,21 @@ def test_pack_sequences_fuzz():
             assert ids[0] == 1 and (np.diff(ids) >= 0).all() and (np.diff(ids) <= 1).all()
             # pad slots carry token 0
             assert (toks[nz.size :] == 0).all()
+
+
+def test_pack_combinator_composes():
+    from dmlcloud_tpu.data import DataPipeline
+
+    rng = np.random.RandomState(7)
+    docs = [rng.randint(1, 100, size=n) for n in (5, 12, 3, 9, 20, 7)]
+    pipe = DataPipeline.from_source(docs).pack(16).batch(2, drop_remainder=False,
+        collate=lambda rows: {k: np.stack([r[k] for r in rows]) for k in rows[0]})
+    pipe.set_epoch(0)
+    batches = list(pipe)
+    got = np.concatenate([
+        b["tokens"][i][b["segment_ids"][i] > 0]
+        for b in batches for i in range(b["tokens"].shape[0])
+    ])
+    np.testing.assert_array_equal(got, np.concatenate(docs))
+    with pytest.raises(ValueError, match="seq_len"):
+        DataPipeline.from_source(docs).pack(0)
