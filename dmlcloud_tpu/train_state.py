@@ -92,20 +92,55 @@ class TrainState(struct.PyTreeNode):
 
 
 def _opt_state_shardings(opt_state: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:
-    """Sharding tree for optimizer state: any leaf whose shape matches a param
-    leaf (Adam mu/nu, momentum) gets that param's sharding; everything else
-    (counts, scalars) is replicated."""
+    """Sharding tree for optimizer state.
+
+    Optimizer slots that mirror the params (Adam mu/nu, momentum) are matched
+    STRUCTURALLY: optax lays them out as subtrees with exactly the params'
+    tree structure, so any such subtree inherits the param shardings
+    one-for-one. This is exact even when two same-shaped params carry
+    different specs (a (shape, dtype) heuristic would silently give both the
+    first-seen layout).
+
+    Leaves that are not part of a param-shaped subtree (step counts, scalar
+    hyperparams, ``optax.masked`` remnants) are replicated — except that a
+    non-scalar stray leaf whose (shape, dtype) maps to exactly ONE param spec
+    still inherits it (unambiguous fallback, e.g. moments inside a masked
+    wrapper whose MaskedNode placeholders break the structure match)."""
     rep = NamedSharding(mesh, P())
-    flat_params = {id(p): s for p, s in zip(jax.tree_util.tree_leaves(params),
-                                            jax.tree_util.tree_leaves(param_shardings))}
+    tu = jax.tree_util
+    params_def = tu.tree_structure(params)
+    param_leaves = tu.tree_leaves(params)
+    shard_leaves = tu.tree_leaves(param_shardings)
+    param_shapes = [getattr(p, "shape", ()) for p in param_leaves]
+
+    # (shape, dtype) -> spec, but only where unambiguous across all params
+    _AMBIG = object()
     shape_map: dict[tuple, Any] = {}
-    for p, s in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(param_shardings)):
-        shape_map.setdefault((getattr(p, "shape", ()), getattr(p, "dtype", None)), s)
+    for p, s in zip(param_leaves, shard_leaves):
+        key = (getattr(p, "shape", ()), getattr(p, "dtype", None))
+        if shape_map.get(key, s) != s:
+            shape_map[key] = _AMBIG
+        else:
+            shape_map.setdefault(key, s)
 
-    def leaf_sharding(leaf):
-        key = (getattr(leaf, "shape", ()), getattr(leaf, "dtype", None))
-        if id(leaf) in flat_params:
-            return flat_params[id(leaf)]
-        return shape_map.get(key, rep)
+    def is_param_shaped(node: Any) -> bool:
+        if tu.tree_structure(node) != params_def:
+            return False
+        leaves = tu.tree_leaves(node)
+        return all(getattr(x, "shape", ()) == shp for x, shp in zip(leaves, param_shapes))
 
-    return jax.tree_util.tree_map(leaf_sharding, opt_state)
+    def assign(node: Any) -> Any:
+        if is_param_shaped(node):
+            return tu.tree_unflatten(params_def, shard_leaves)
+        # one-level decomposition: children of this node, or the node itself
+        # when it is already a leaf
+        children, treedef = tu.tree_flatten(node, is_leaf=lambda x: x is not node)
+        if len(children) == 1 and children[0] is node:
+            shape = getattr(node, "shape", ())
+            if shape:  # non-scalar stray leaf: unambiguous shape fallback
+                spec = shape_map.get((shape, getattr(node, "dtype", None)), rep)
+                return rep if spec is _AMBIG else spec
+            return rep
+        return tu.tree_unflatten(treedef, [assign(c) for c in children])
+
+    return assign(opt_state)
