@@ -28,6 +28,7 @@ preserved exactly.
 
 from __future__ import annotations
 
+import logging
 from enum import Enum
 from typing import Any, Iterable
 
@@ -35,6 +36,8 @@ import jax
 import numpy as np
 
 from .parallel import runtime
+
+_logger = logging.getLogger(__name__)
 
 
 class Reduction(Enum):
@@ -201,9 +204,24 @@ def _name_fingerprint(names: list[str]) -> np.float32:
     return np.float32(zlib.crc32("\x00".join(names).encode()) % (2**24 - 3))
 
 
-def _pack_scalar_metrics(names: list[str], local: dict[str, tuple[bool, Any]]) -> np.ndarray:
+#: Metrics already warned about (once per process) for float32 exactness loss.
+_INEXACT_SUM_WARNED: set[str] = set()
+
+
+def _pack_scalar_metrics(
+    names: list[str],
+    local: dict[str, tuple[bool, Any]],
+    reductions: dict[str, Reduction] | None = None,
+) -> np.ndarray:
     """``[fingerprint | empty bits | values]`` as one float32 vector — the
-    payload of the single-collective epoch exchange."""
+    payload of the single-collective epoch exchange.
+
+    Values transit as float32, so an integer SUM counter loses exactness past
+    2**24. Rerouting such a metric at runtime is NOT safe (routing must be
+    identical on every rank or the collective shapes diverge), so the guard
+    is a loud once-per-metric warning naming the exact fix; the cross-rank
+    combine itself happens in float64 (``_unpack_scalar_metrics``), so the
+    pack-time rounding checked here is the only loss point."""
     n = len(names)
     vec = np.zeros(1 + 2 * n, np.float32)
     vec[0] = _name_fingerprint(names)
@@ -212,6 +230,17 @@ def _pack_scalar_metrics(names: list[str], local: dict[str, tuple[bool, Any]]) -
         vec[1 + i] = 1.0 if empty else 0.0
         if not empty:
             vec[1 + n + i] = np.float32(val)
+            if reductions is not None and reductions.get(name) is Reduction.SUM:
+                v = float(np.asarray(val))
+                if v == round(v) and float(vec[1 + n + i]) != v and name not in _INEXACT_SUM_WARNED:
+                    _INEXACT_SUM_WARNED.add(name)
+                    _logger.warning(
+                        "Metric %r: integer SUM counter %.0f exceeds float32's exact "
+                        "range (2**24) and loses precision in the packed metric "
+                        "exchange. Register it with dim=() to route it through the "
+                        "exact object exchange, or track a float statistic instead.",
+                        name, v,
+                    )
     return vec
 
 
@@ -236,7 +265,9 @@ def _unpack_scalar_metrics(
                 )
             out[name] = None
         else:
-            out[name] = _combine_across(list(gathered[:, 1 + n + i]), reductions[name])
+            # float64 combine: the f32-exact per-rank values sum exactly up
+            # to 2**53, so cross-rank accumulation adds no further rounding
+            out[name] = _combine_across(list(gathered[:, 1 + n + i].astype(np.float64)), reductions[name])
     return out
 
 
@@ -357,9 +388,9 @@ class MetricTracker:
             scalar_names = sorted(n for n in local if self.reducers[n].dim is None)
             other = {n: local[n] for n in local if n not in scalar_names}
             if scalar_names:
-                packed = _pack_scalar_metrics(scalar_names, local)
-                gathered = runtime.all_gather_array(packed)
                 reductions = {n: self.reducers[n].reduction for n in scalar_names}
+                packed = _pack_scalar_metrics(scalar_names, local, reductions)
+                gathered = runtime.all_gather_array(packed)
                 fused.update(_unpack_scalar_metrics(scalar_names, gathered, reductions))
             if other:
                 gathered_obj = runtime.all_gather_object(other)  # list over ranks

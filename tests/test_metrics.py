@@ -71,6 +71,35 @@ class TestFusedScalarExchange:
         out = _unpack_scalar_metrics(["count"], gathered, {"count": Reduction.SUM})
         assert int(out["count"]) == sum(2**20 + i for i in range(4))
 
+    def test_int_sum_exact_past_2_24_combined(self):
+        """Per-rank values that are f32-exact must combine exactly even when
+        the cross-rank TOTAL exceeds 2**24 (the combine runs in float64)."""
+        locals_ = [{"count": (False, 2**23)}, {"count": (False, 2**23 + 1)}]
+        gathered = np.stack([_pack_scalar_metrics(["count"], loc) for loc in locals_])
+        out = _unpack_scalar_metrics(["count"], gathered, {"count": Reduction.SUM})
+        assert int(out["count"]) == 2**24 + 1  # not representable in f32
+
+    def test_inexact_sum_counter_warns_loudly(self, caplog):
+        """An integer SUM counter past 2**24 gets a once-per-metric warning
+        naming the exact fix (ADVICE/VERDICT r3: the caveat must be loud)."""
+        import logging
+
+        from dmlcloud_tpu import metrics as metrics_mod
+
+        metrics_mod._INEXACT_SUM_WARNED.discard("big")
+        reds = {"big": Reduction.SUM, "loss": Reduction.MEAN}
+        local = {"big": (False, 2**24 + 1), "loss": (False, 2**24 + 1.0)}
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu.metrics"):
+            _pack_scalar_metrics(["big", "loss"], local, reds)
+        warnings = [r for r in caplog.records if "exact" in r.getMessage()]
+        assert len(warnings) == 1  # SUM counter warns; MEAN float does not
+        assert "big" in warnings[0].getMessage()
+        assert "dim=()" in warnings[0].getMessage()
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu.metrics"):
+            _pack_scalar_metrics(["big", "loss"], local, reds)
+        assert not [r for r in caplog.records if "exact" in r.getMessage()]  # once per metric
+
 
 class TestReduceTensor:
     def test_mean_all_dims(self):
