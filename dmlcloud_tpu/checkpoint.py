@@ -46,24 +46,43 @@ def is_remote_path(path: Any) -> bool:
     return "://" in os.fspath(path)
 
 
-def _normalize_opt(v: Any) -> Any:
+def _normalize_opt(v: Any, _seen: frozenset = frozenset()) -> Any:
     """Structural key for an Orbax option value, comparable across calls.
     Callables (e.g. a ``BestN.get_metric_fn`` lambda rebuilt per call) map to
-    their qualname and dataclass policies to their field structure, so
-    re-specifying an identical configuration is idempotent instead of
-    tripping the changed-options guard on lambda identity."""
+    their qualname PLUS their captured closure values (two lambdas from the
+    same source line closing over different metric names must not compare
+    equal) and dataclass policies to their field structure, so re-specifying
+    an identical configuration is idempotent instead of tripping the
+    changed-options guard on lambda identity. The result contains only
+    plain comparable values — arbitrary objects (arrays!) reduce to
+    ``(type, repr)`` so ``==`` never goes ambiguous — and self-referential
+    closures terminate via the ``_seen`` id-set."""
     import dataclasses
 
+    if id(v) in _seen:
+        return "<recursive>"
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        sub = _seen | {id(v)}
         return (
             type(v).__name__,
-            tuple((f.name, _normalize_opt(getattr(v, f.name))) for f in dataclasses.fields(v)),
+            tuple((f.name, _normalize_opt(getattr(v, f.name), sub)) for f in dataclasses.fields(v)),
         )
     if callable(v):
-        return getattr(v, "__qualname__", repr(type(v)))
+        key: Any = getattr(v, "__qualname__", repr(type(v)))
+        cells = getattr(v, "__closure__", None)
+        if cells:
+            sub = _seen | {id(v)}
+            try:
+                key = (key, tuple(_normalize_opt(c.cell_contents, sub) for c in cells))
+            except ValueError:  # an empty (yet-unassigned) cell
+                pass
+        return key
     if isinstance(v, (list, tuple)):
-        return tuple(_normalize_opt(x) for x in v)
-    return v
+        sub = _seen | {id(v)}
+        return tuple(_normalize_opt(x, sub) for x in v)
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return v
+    return (type(v).__name__, repr(v))
 
 
 def atomic_write_text(target: epath.Path, text: str) -> None:

@@ -50,7 +50,12 @@ def _sample(logits, rng, temperature: float, top_k: int, top_p: float):
         # mass reaches top_p (the first token always stays)
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         csum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-        cutoff_idx = jnp.sum(csum < top_p, axis=-1, keepdims=True)  # first index reaching p
+        # first index reaching p; clamped so a cumsum that never reaches
+        # top_p (rounding near 1.0) keeps everything EXPLICITLY instead of
+        # via take_along_axis's implicit clip-at-bounds indexing
+        cutoff_idx = jnp.minimum(
+            jnp.sum(csum < top_p, axis=-1, keepdims=True), logits.shape[-1] - 1
+        )
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -170,6 +170,10 @@ def _attn_kernel(
         new_m = jnp.maximum(m_prev, blk_max)
         correction = jnp.exp(m_prev - new_m)
         p = jnp.exp(s - new_m)  # [bq, bk]
+        # a row fully masked within this visited block has s == new_m ==
+        # _NEG_INF, making p == exp(0) == 1 per masked entry — zero it so
+        # dead rows really keep l == 0 / out == 0 (not a mean of V)
+        p = jnp.where(blk_max > _NEG_INF / 2, p, 0.0)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -185,11 +189,12 @@ def _attn_kernel(
 
     @pl.when(kb == num_kb - 1)
     def _write():
-        # dead rows (every K block skipped — possible for windowed
-        # non-causal ring hops) keep l == 0: the tiny floor makes their
-        # output 0 and their lse ~ -1e30 - 69 (FINITE, so the ring merge
-        # weight underflows to exactly 0 and the backward's exp(s - lse)
-        # stays finite); live rows always have l >~ 1, untouched
+        # dead rows (every K block skipped, or fully masked in every block
+        # actually visited — both possible for windowed non-causal ring
+        # hops) keep l == 0 thanks to the dead-row p-zeroing above: the tiny
+        # floor makes their output 0 and their lse ~ -1e30 - 69 (FINITE, so
+        # the ring merge weight underflows to exactly 0 and the backward's
+        # exp(s - lse) stays finite); live rows always have l >~ 1, untouched
         l_safe = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
         if lse_ref is not None:

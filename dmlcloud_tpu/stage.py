@@ -290,9 +290,12 @@ class TrainValStage(Stage):
         disables). The registered batch is split along its leading axis and
         scanned with ``lax.scan`` INSIDE the one compiled step — grads and
         metrics accumulate in fp32 on device, the optimizer applies once.
-        Losses and grads are AVERAGED over microbatches, so equivalence with
-        the unaccumulated step requires ``step`` to return a mean-reduced
-        loss (a sum-reduced loss would be rescaled by 1/accum).
+        Losses, grads, AND step metrics are AVERAGED over microbatches, so
+        equivalence with the unaccumulated step requires ``step`` to return
+        mean-reduced values: a sum-reduced loss would be rescaled by
+        1/accum, and a count-style metric (e.g. samples seen) silently
+        changes scale by 1/accum — derive counts from the batch size
+        outside ``step`` instead.
         This is the TPU shape of large effective batches under a tight HBM
         budget: one trace, one dispatch, no host round trips per microbatch.
         (The reference has no equivalent; its imperative loop would pay

@@ -148,3 +148,42 @@ class TestRemotePaths:
         atomic_write_text(local, "x")
         assert (tmp_path / "local.json").read_text() == "x"
         assert not list(tmp_path.glob(".*.tmp"))
+
+
+def test_normalize_opt_distinguishes_closures():
+    """Two lambdas from the same source line with different captured values
+    must normalize differently (a changed best-metric name must trip the
+    changed-options guard, not silently pass)."""
+    from dmlcloud_tpu.checkpoint import _normalize_opt
+
+    def make(name):
+        return lambda metrics: metrics[name]
+
+    assert _normalize_opt(make("val/loss")) != _normalize_opt(make("val/acc"))
+    assert _normalize_opt(make("val/loss")) == _normalize_opt(make("val/loss"))
+
+
+def test_normalize_opt_handles_arrays_and_recursion():
+    """Closure cells holding arrays or self-references must normalize to
+    plain comparable values — no ambiguous-truth ValueError, no infinite
+    recursion."""
+    import numpy as np
+
+    from dmlcloud_tpu.checkpoint import _normalize_opt
+
+    baseline = np.arange(4.0)
+
+    def make():
+        return lambda m: m["loss"] - baseline
+
+    a, b = _normalize_opt(make()), _normalize_opt(make())
+    assert (a == b) in (True, False)  # plain comparable, not array-ambiguous
+    assert a == b
+
+    def rec():
+        def inner(x):
+            return inner(x)
+
+        return inner
+
+    assert _normalize_opt(rec()) == _normalize_opt(rec())

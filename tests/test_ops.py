@@ -11,6 +11,8 @@ from dmlcloud_tpu.ops.flash_attention import flash_attention
 from dmlcloud_tpu.ops.ring_attention import ring_attention_sharded
 from dmlcloud_tpu.parallel import mesh as mesh_lib
 
+pytestmark = pytest.mark.slow
+
 
 def _qkv(b=2, t=128, h=4, kh=None, d=32, seed=0, dtype=jnp.float32):
     kh = kh or h
@@ -34,6 +36,29 @@ class TestFlashAttention:
         expected = _dot_attention(q, k, v, causal=True)
         out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_dead_rows_write_exact_zero(self):
+        """A row fully masked inside VISITED blocks (possible only through
+        the internal shifted-window path the ring's behind-hops use) must
+        write out == 0 and an effectively -inf lse — not a mean of V."""
+        from dmlcloud_tpu.ops.flash_attention import _flash_lse
+
+        q, k, v = _qkv(b=1, t=64, h=1, d=16)
+        # internal call: causal=False, window=0 keeps only k_pos > q_pos,
+        # so the LAST row attends to nothing while its K blocks are visited
+        out, lse = _flash_lse(q, k, v, None, False, 1.0, 32, 32, True, 0)
+        out = np.asarray(out)
+        lse = np.asarray(lse).reshape(1, 1, 64)  # raw [B*H, T]
+        assert np.all(out[0, -1, 0] == 0.0)
+        assert lse[0, 0, -1] < -1e29
+        # live rows match a reference softmax over their keys (k > q)
+        s = np.einsum("td,sd->ts", np.asarray(q)[0, :, 0], np.asarray(k)[0, :, 0])
+        mask = np.arange(64)[None, :] > np.arange(64)[:, None]
+        s = np.where(mask, s, -np.inf)
+        p = np.exp(s[:-1] - s[:-1].max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        expected = p @ np.asarray(v)[0, :, 0]
+        np.testing.assert_allclose(out[0, :-1, 0], expected, atol=2e-5, rtol=2e-5)
 
     def test_block_divisibility_enforced(self):
         q, k, v = _qkv(t=100)
