@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import re
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Callable, Optional
@@ -65,7 +66,8 @@ class TrainingPipeline:
         self.current_stage = None
 
         self.wandb = False
-        self._wandb_initializer = None
+        self._wandb_opts: dict | None = None
+        self._wandb_timeout = 360
 
         self.stages: list[Stage] = []
         self.datasets: dict[str, Any] = {}
@@ -158,19 +160,22 @@ class TrainingPipeline:
             self.schedulers[name] = scheduler
 
     def register_dataset(self, name: str, dataset: Any, verbose: bool = True):
+        """Register a per-process dataset shard under ``name`` ('train'/'val'
+        are the names TrainValStage looks up). Any iterable of batches works:
+        a DataPipeline, a DataLoader shim, or a plain list."""
         if name in self.datasets:
             raise ValueError(f"Dataset with name {name} already exists")
         self.datasets[name] = dataset
         if verbose:
-            msg = f'Dataset "{name}":\n'
             try:
-                length = len(dataset)
-                msg += f"    - Batches (Total): ~{length * runtime.world_size()}\n"
-                msg += f"    - Batches (/Worker): {length}\n"
-            except TypeError:
-                msg += "    - Batches (Total): N/A\n"
-                msg += "    - Batches (/Worker): N/A\n"
-            self.logger.info(msg)
+                per_worker: Any = len(dataset)
+                total: Any = f"~{per_worker * runtime.world_size()}"
+            except TypeError:  # iterable-only pipelines carry no length
+                per_worker = total = "unknown"
+            self.logger.info(
+                'Dataset "%s": %s batches/worker, %s total across %d processes',
+                name, per_worker, total, runtime.world_size(),
+            )
 
     def append_stage(self, stage: Stage, max_epochs: Optional[int] = None, name: Optional[str] = None):
         if not isinstance(stage, Stage):
@@ -251,23 +256,33 @@ class TrainingPipeline:
         startup_timeout: int = 360,
         **kwargs,
     ):
-        import wandb as _wandb  # import now to catch a missing install early
+        """Send the tracker's per-epoch metrics to Weights & Biases.
 
-        @runtime.root_only
-        def initializer():
-            wandb_set_startup_timeout(startup_timeout)
-            _wandb.init(
-                config=self.config.to_dict(resolve=True),
-                name=self.name,
-                entity=entity,
-                project=project if project else self.name,
-                group=group,
-                tags=tags,
-                **kwargs,
-            )
+        Only stores the run options here; the root process opens the actual
+        wandb run during ``_pre_run`` (after the runtime and config are
+        final). Extra ``kwargs`` pass straight through to ``wandb.init``."""
+        import wandb as _wandb  # noqa: F401 — surface a missing install at call time
 
-        self._wandb_initializer = initializer
+        self._wandb_opts = dict(
+            entity=entity,
+            project=project or self.name,
+            group=group,
+            tags=tags,
+            **kwargs,
+        )
+        self._wandb_timeout = startup_timeout
         self.wandb = True
+
+    @runtime.root_only
+    def _start_wandb(self):
+        import wandb as _wandb
+
+        wandb_set_startup_timeout(self._wandb_timeout)
+        _wandb.init(
+            config=self.config.to_dict(resolve=True),
+            name=self.name,
+            **self._wandb_opts,
+        )
 
     # -------------------------------------------------------------- metrics
     def track_reduce(
@@ -279,11 +294,16 @@ class TrainingPipeline:
         dim: list[int] | None = None,
         reduce_globally: bool = True,
     ):
+        """Buffer ``value`` under an epoch-end reduction. The metric is
+        registered on first use; the reduction arguments only take effect
+        then (subsequent calls just append)."""
         if name not in self.tracker:
             self.tracker.register_metric(name, reduction, dim, reduce_globally)
         self.tracker.track(name, value)
 
     def track(self, name: str, value: Any, step: int | None = None):
+        """Record an already-final (unreduced, process-local) value for the
+        current epoch."""
         if name not in self.tracker:
             self.tracker.register_metric(name)
         self.tracker.track(name, value)
@@ -295,7 +315,7 @@ class TrainingPipeline:
     # ------------------------------------------------------------ lifecycle
     def run(self):
         """Run all registered stages sequentially."""
-        with _RunGuard(self):
+        with _run_guard(self):
             self._pre_run()
             for stage in self.stages:
                 self.current_stage = stage
@@ -335,7 +355,7 @@ class TrainingPipeline:
             self._init_checkpointing()
 
         if self.wandb:
-            self._wandb_initializer()
+            self._start_wandb()
 
         self.barrier(timeout=600)
         self.start_time = datetime.now()
@@ -388,30 +408,25 @@ class TrainingPipeline:
             metrics = {name: self.tracker[name][-1] for name in self.tracker if self.tracker[name]}
             wandb.log(metrics)
 
-    def _cleanup(self, exc_type, exc_value, traceback):
-        """Guaranteed teardown (reference pipeline.py:303-320)."""
-        if exc_type is KeyboardInterrupt:
-            self.logger.info("------- Training interrupted by user -------")
-        elif exc_type is not None:
-            self.logger.error(
-                "------- Training failed with an exception -------", exc_info=(exc_type, exc_value, traceback)
-            )
-
+    def _teardown(self, exc: BaseException | None) -> None:
+        """Guaranteed teardown — runs whether the stages finished, raised, or
+        were interrupted; the exception (if any) propagates afterwards."""
+        if isinstance(exc, KeyboardInterrupt):
+            self.logger.info("=== run aborted by user (KeyboardInterrupt) ===")
+        elif exc is not None:
+            self.logger.error("=== run failed; traceback follows ===", exc_info=exc)
         if self.wandb and wandb_is_initialized():
-            wandb.finish(exit_code=0 if exc_type is None else 1)
-
+            wandb.finish(exit_code=0 if exc is None else 1)
         if self.io_redirector is not None:
             self.io_redirector.uninstall()
 
-        return False
 
-
-class _RunGuard:
-    def __init__(self, pipeline):
-        self.pipeline = pipeline
-
-    def __enter__(self):
-        pass
-
-    def __exit__(self, exc_type, exc_value, traceback):
-        return self.pipeline._cleanup(exc_type, exc_value, traceback)
+@contextmanager
+def _run_guard(pipeline: TrainingPipeline):
+    try:
+        yield
+    except BaseException as exc:
+        pipeline._teardown(exc)
+        raise
+    else:
+        pipeline._teardown(None)
