@@ -46,6 +46,7 @@ def _greedy_no_cache(model, params, prompt, n):
     return jnp.stack(out, axis=1)
 
 
+@pytest.mark.slow
 def test_greedy_matches_no_cache():
     cfg = _tiny_cfg()
     model, params, prompt = _init(cfg)
@@ -54,6 +55,7 @@ def test_greedy_matches_no_cache():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_gqa_greedy_matches_no_cache():
     cfg = _tiny_cfg(num_kv_heads=2)
     model, params, prompt = _init(cfg)
@@ -87,6 +89,7 @@ def test_sampling_deterministic_under_rng():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+@pytest.mark.slow
 def test_moe_decode_runs():
     cfg = _tiny_cfg(num_experts=2, moe_every=2)
     model, params, prompt = _init(cfg)
@@ -108,6 +111,7 @@ def test_init_cache_shapes():
     assert cache["layer_0"]["k"].shape == (3, 32, 2, 8)
 
 
+@pytest.mark.slow
 def test_top_p_sampling():
     cfg = _tiny_cfg()
     model, params, prompt = _init(cfg)
@@ -133,6 +137,7 @@ class TestBeamSearch:
         np.testing.assert_array_equal(np.asarray(beams), np.asarray(greedy))
         assert np.isfinite(np.asarray(scores)).all()
 
+    @pytest.mark.slow
     def test_full_beam_finds_global_optimum(self):
         """With K = V^(N-1) beams, beam search is exhaustive: its winner must
         be the true argmax over all V^N continuations, scored by rerunning
@@ -157,6 +162,7 @@ class TestBeamSearch:
         assert tuple(np.asarray(beams)[0].tolist()) == best_cont
         assert abs(float(score[0]) - all_scores[best_cont] / n) < 1e-4  # len-normalised
 
+    @pytest.mark.slow
     def test_beam_scores_are_honest(self):
         """The reported score must equal rescoring the winning continuation
         with the full model (beam >= greedy is NOT asserted — the greedy
@@ -202,6 +208,7 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="vocab"):
             beam_search(model, params, prompt, 4, num_beams=100)
 
+    @pytest.mark.slow
     def test_eos_freezes_multi_beam(self):
         """With k > 1, any beam that emits eos must continue as pure pad
         (exercises reorder + freeze interaction, not just the k=1 identity)."""
@@ -241,6 +248,7 @@ class TestBeamSearch:
 
 
 class TestRaggedPrompts:
+    @pytest.mark.slow
     def test_left_padded_rows_match_unpadded(self):
         """Each left-padded row must decode exactly as its unpadded self."""
         cfg = _tiny_cfg()
@@ -298,6 +306,7 @@ class TestRaggedPrompts:
             generate(model, params, prompt, 4, prompt_mask=np.ones(7, np.int32))
 
 
+@pytest.mark.slow
 def test_ragged_beam_rows_match_unpadded():
     from dmlcloud_tpu.models.generate import beam_search
 

@@ -6,6 +6,7 @@ import numpy as np
 
 from dmlcloud_tpu.models.moe import MoEConfig, MoEMLP, moe_partition_rules, total_aux_loss
 from dmlcloud_tpu.parallel import mesh as mesh_lib
+import pytest
 
 B, T, D = 2, 16, 8
 
@@ -21,12 +22,14 @@ def make_layer(**overrides):
 
 
 class TestMoEMLP:
+    @pytest.mark.slow
     def test_forward_shape_and_finite(self):
         model, params, x = make_layer()
         y = model.apply(params, x)
         assert y.shape == x.shape
         assert np.isfinite(np.asarray(y)).all()
 
+    @pytest.mark.slow
     def test_output_nonzero_with_ample_capacity(self):
         # capacity_factor high enough that no token is dropped: every token
         # got routed, so no row of the output should be exactly zero.
@@ -94,6 +97,7 @@ class TestExpertParallel:
 
 
 class TestMoETransformer:
+    @pytest.mark.slow
     def test_decoder_lm_with_moe(self):
         from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
 

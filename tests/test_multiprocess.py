@@ -19,6 +19,8 @@ import pytest
 
 from dmlcloud_tpu.utils.tcp import find_free_port
 
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _PRELUDE = """

@@ -10,6 +10,8 @@ import pytest
 
 from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
 
+pytestmark = pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(

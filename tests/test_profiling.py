@@ -5,8 +5,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from dmlcloud_tpu.utils.profiling import StepTimer, profile_steps, trace
+import pytest
 
 
+@pytest.mark.slow
 def test_trace_writes_profile(tmp_path):
     logdir = tmp_path / "prof"
     with trace(str(logdir)):
