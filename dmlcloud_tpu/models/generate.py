@@ -5,11 +5,14 @@ this is TPU-side scope).
 TPU-first shape of the problem:
 
 - The KV cache is a static-shape pytree ([B, max_len, KH, D] per layer,
-  bf16); every decode step writes one slot with ``dynamic_update_slice``
-  and attends over the full buffer with the unwritten tail masked — no
-  dynamic shapes anywhere, so the whole loop compiles once.
+  bf16); every decode step writes one slot with ``dynamic_update_slice``.
+  Attention reads only a STATIC prefix of the buffer (``attend_len``),
+  grown chunk-by-chunk as the cache fills, so per-token attention cost
+  scales with the filled length instead of max_len — while every shape
+  stays static.
 - Generation is ONE jitted program: prefill over the (padded) prompt, then
-  ``lax.scan`` over decode steps. No per-token Python dispatch; the only
+  a short chain of ``lax.scan`` segments (one per attend-length chunk,
+  at most ``_DECODE_CHUNKS``). No per-token Python dispatch; the only
   host transfer is the final token matrix.
 - Sampling is functional: greedy at ``temperature=0``, otherwise
   temperature softmax with optional top-k and nucleus (top-p) truncation,
@@ -25,6 +28,12 @@ import jax
 import jax.numpy as jnp
 
 from .transformer import DecoderLM, TransformerConfig
+
+
+#: Max number of scan segments in a chunked decode: bounds trace/compile
+#: size (each segment is one scan body) while the growing attend_len keeps
+#: attention work proportional to fill.
+_DECODE_CHUNKS = 8
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int | None = None, dtype=jnp.bfloat16):
@@ -85,7 +94,10 @@ def _generate_compiled(
     # Prefill: one pass over the whole prompt fills cache slots [0, t).
     # Left padding means every row's LAST slot is real, so sampling reads
     # logits[:, -1] and decode write offsets stay uniform across rows.
-    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len)
+    # attend_len=t: the empty generation tail is never read.
+    logits, cache = model.apply(
+        {"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len, attend_len=t
+    )
     last = logits[:, -1]  # [B, V]
 
     def sample_next(prev_logits, rng, done):
@@ -93,21 +105,34 @@ def _generate_compiled(
         tok = jnp.where(done, pad_id, tok)
         return tok, done | (tok == eos_id)
 
-    def step(carry, i):
+    def step(carry, i, attend_len):
         cache, prev_logits, rng, done = carry
         rng, sub = jax.random.split(rng)
         tok, done = sample_next(prev_logits, sub, done)
         logits, cache = model.apply(
-            {"params": params}, tok[:, None], cache=cache, offset=t + i, pad_len=pad_len
+            {"params": params}, tok[:, None], cache=cache, offset=t + i, pad_len=pad_len,
+            attend_len=attend_len,
         )
         return (cache, logits[:, 0], rng, done), tok
 
-    # scan N-1 decode steps; the Nth token needs only a sample, not another
-    # forward pass (whose logits nothing would consume)
-    init = (cache, last, rng, jnp.zeros((b,), bool))
-    (cache, last, rng, done), tokens = jax.lax.scan(step, init, jnp.arange(max_new_tokens - 1))
+    # N-1 decode steps as a chain of scans (the Nth token needs only a
+    # sample, not another forward pass): each scan segment attends over a
+    # statically-bounded prefix that grows with the fill, so attention work
+    # totals O(N * (t + N/2)) instead of O(N * (t + N))
+    n_steps = max_new_tokens - 1
+    chunk = -(-n_steps // _DECODE_CHUNKS) if n_steps else 1
+    carry = (cache, last, rng, jnp.zeros((b,), bool))
+    chunks = []
+    for start in range(0, n_steps, chunk):
+        end = min(start + chunk, n_steps)
+        # last step in this segment writes slot t + end - 1
+        seg_step = functools.partial(step, attend_len=t + end)
+        carry, toks = jax.lax.scan(seg_step, carry, jnp.arange(start, end))
+        chunks.append(toks)
+    cache, last, rng, done = carry
     final_tok, _ = sample_next(last, jax.random.split(rng)[1], done)
-    return jnp.concatenate([tokens, final_tok[None]], axis=0).T  # [B, max_new_tokens]
+    tokens = jnp.concatenate(chunks + [final_tok[None]], axis=0)
+    return tokens.T  # [B, max_new_tokens]
 
 
 def _pad_len_from_mask(prompt_mask, b: int, t: int):
@@ -196,7 +221,9 @@ def _beam_search_compiled(
 
     # Prefill once per batch row, then tile the cache across beams.
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
-    logits, cache = model.apply({"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len)
+    logits, cache = model.apply(
+        {"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len, attend_len=t
+    )
     cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, k, axis=0), cache)  # [B*K, ...]
     pad_len_k = None if pad_len is None else jnp.repeat(pad_len, k, axis=0)  # beam-tiled
     first_lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]

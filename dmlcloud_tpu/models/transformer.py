@@ -211,7 +211,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None):
         cfg = self.cfg
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
@@ -250,12 +250,20 @@ class Attention(nn.Module):
                 out = _dot_attention(q, k, v, mask=mask)
         elif cache is not None:
             # Autoregressive decode: write this call's K/V into the static-
-            # shape cache at ``offset`` and attend over the whole buffer with
-            # the unwritten tail masked out — static shapes keep XLA happy,
-            # O(max_len) work per step is the standard TPU decode trade.
+            # shape cache at ``offset`` and attend over the FILLED prefix
+            # with the unwritten tail masked out. ``attend_len`` (STATIC,
+            # chunk-rounded by the caller — generate.py grows it as the
+            # cache fills) bounds the slots actually read, so per-token
+            # attention cost scales with fill instead of max_len while
+            # every shape stays static for XLA.
             k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, offset, 0, 0))
             v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, offset, 0, 0))
+            new_cache = {"k": k, "v": v}
             s = k.shape[1]
+            if attend_len is not None and attend_len < s:
+                s = int(attend_len)
+                k = jax.lax.slice_in_dim(k, 0, s, axis=1)
+                v = jax.lax.slice_in_dim(v, 0, s, axis=1)
             q_pos = offset + jnp.arange(t)[:, None]  # [t, 1]
             kv_pos = jnp.arange(s)[None, :]  # [1, s]
             mask = kv_pos <= q_pos  # causal AND only written slots
@@ -266,7 +274,6 @@ class Attention(nn.Module):
                 pad_len, _ = decode_pad
                 mask = mask[None] & (kv_pos[None] >= pad_len[:, None, None])
             out = _dot_attention(q, k, v, mask=mask)
-            new_cache = {"k": k, "v": v}
         elif cfg.attn_impl == "flash":
             from ..ops.flash_attention import flash_attention
 
@@ -319,12 +326,13 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None):
+    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None):
         cfg = self.cfg
         new_cache = None
         if cache is not None:
             attn_out, new_cache = Attention(cfg, name="attn")(
-                RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset, decode_pad=decode_pad
+                RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset,
+                decode_pad=decode_pad, attend_len=attend_len,
             )
             x = x + attn_out
         else:
@@ -360,10 +368,12 @@ class DecoderLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None):
+    def __call__(self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None, attend_len=None):
         cfg = self.cfg
         if pad_len is not None and cache is None:
             raise ValueError("pad_len (left-padded ragged prompts) is a decode-mode feature")
+        if attend_len is not None and cache is None:
+            raise ValueError("attend_len (bounded cache reads) is a decode-mode feature")
         decode_pad = None
         if pad_len is not None:
             positions = jnp.maximum(jnp.arange(tokens.shape[1])[None, :] + offset - pad_len[:, None], 0)
@@ -412,7 +422,8 @@ class DecoderLM(nn.Module):
             name = f"layer_{i}"
             if cache is not None:
                 x, new_cache[name] = DecoderBlock(cfg, use_moe=use_moe, name=name)(
-                    x, cos, sin, cache=cache[name], offset=offset, decode_pad=decode_pad
+                    x, cos, sin, cache=cache[name], offset=offset, decode_pad=decode_pad,
+                    attend_len=attend_len,
                 )
                 x = constrain(x)
             else:

@@ -327,3 +327,19 @@ def test_ragged_beam_rows_match_unpadded():
     np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(want1)[0])
     np.testing.assert_array_equal(np.asarray(got)[1], np.asarray(want2)[0])
     np.testing.assert_allclose(np.asarray(scores), [float(s1[0]), float(s2[0])], atol=1e-5)
+
+
+def test_attend_len_bounds_cache_reads():
+    """With attend_len set, slots past it must never be READ: poison the
+    cache tail with NaN and the logits must stay finite and equal to the
+    clean-cache result. This is the property that makes decode cost scale
+    with fill instead of max_len."""
+    model, params, prompt = _init(_tiny_cfg(), batch=2, t=8)
+    cache = init_cache(model.cfg, 2, 32, dtype=model.cfg.dtype)
+    clean, _ = model.apply({"params": params}, prompt, cache=cache, offset=0, attend_len=8)
+    poisoned = jax.tree_util.tree_map(lambda x: x.at[:, 8:].set(jnp.nan), cache)
+    got, new_cache = model.apply({"params": params}, prompt, cache=poisoned, offset=0, attend_len=8)
+    assert bool(jnp.all(jnp.isfinite(got)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6)
+    # the returned cache is still the FULL buffer (writes are never bounded)
+    assert new_cache["layer_0"]["k"].shape[1] == 32
