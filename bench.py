@@ -160,39 +160,50 @@ class ResNetBenchStage(dml.TrainValStage):
         pass
 
 
-def bench_framework(batch) -> float:
-    pipeline = dml.TrainingPipeline(name="bench-resnet50")
-    stage = ResNetBenchStage(batch)
-    pipeline.append_stage(stage, max_epochs=1)
-
-    # Timer hook: start the clock once the warmup steps (incl. compile) have
-    # fully executed on device; everything after is the measured tail.
-    t_start = []
+def _instrument_stage(stage):
+    """Timer hook: start the clock once the warmup steps (incl. compile) have
+    fully executed on device; everything after is the measured tail. Returns
+    the list that receives [t_after_warmup, t_after_timed]."""
+    marks: list = []
     count = [0]
     orig_build = stage._build_train_step
 
     def instrumented_build():
         fn = orig_build()
-
         loss_name = stage.loss_metric_name()
 
         def wrapped(state, b):
             out = fn(state, b)
             count[0] += 1
-            if count[0] == WARMUP_STEPS:
-                float(out[1][loss_name])  # force warmup chain to completion
-                t_start.append(time.perf_counter())
-            elif count[0] == WARMUP_STEPS + TIMED_STEPS:
-                float(out[1][loss_name])  # force timed chain to completion
-                t_start.append(time.perf_counter())
+            if count[0] in (WARMUP_STEPS, WARMUP_STEPS + TIMED_STEPS):
+                float(out[1][loss_name])  # value fetch forces the whole chain
+                marks.append(time.perf_counter())
             return out
 
         return wrapped
 
     stage._build_train_step = instrumented_build
+    return marks
+
+
+def bench_framework(batch) -> float:
+    pipeline = dml.TrainingPipeline(name="bench-resnet50")
+    stage = ResNetBenchStage(batch)
+    pipeline.append_stage(stage, max_epochs=1)
+    marks = _instrument_stage(stage)
     pipeline.run()
     batch_size = int(batch["label"].shape[0])
-    return TIMED_STEPS * batch_size / (t_start[1] - t_start[0])
+    return TIMED_STEPS * batch_size / (marks[1] - marks[0])
+
+
+def _lm_model(s=1024, layers=12, vocab=32000):
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=12, num_kv_heads=4, head_dim=64,
+        hidden_dim=768, mlp_dim=2048, max_seq_len=s, dtype=jnp.bfloat16, attn_impl="flash",
+    )
+    return DecoderLM(cfg), cfg
 
 
 def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000):
@@ -201,13 +212,9 @@ def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000):
     6·params FLOPs/token training estimate."""
     import jax.tree_util as jtu
 
-    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
+    from dmlcloud_tpu.models.transformer import lm_loss
 
-    cfg = TransformerConfig(
-        vocab_size=vocab, num_layers=layers, num_heads=12, num_kv_heads=4, head_dim=64,
-        hidden_dim=768, mlp_dim=2048, max_seq_len=s, dtype=jnp.bfloat16, attn_impl="flash",
-    )
-    model = DecoderLM(cfg)
+    model, cfg = _lm_model(s, layers, vocab)
     tokens = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (b, s)), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:1, :8])["params"]
     # MFU counts matmul params only (PaLM convention): the embedding table
@@ -238,6 +245,68 @@ def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000):
     tps = b * s / dt
     mfu = tps * 6 * n_params / chip_peak_flops()
     return tps, mfu
+
+
+class LMBenchStage(dml.TrainValStage):
+    """The transformer family's framework path: DecoderLM + flash attention
+    driven through TrainingPipeline/TrainValStage, so the flagship features
+    get the same overhead measurement bench_framework gives ResNet."""
+
+    def __init__(self, tokens, s, layers, vocab):
+        super().__init__()
+        self._tokens = tokens
+        self._shape = (s, layers, vocab)
+
+    def pre_stage(self):
+        model, cfg = _lm_model(*self._shape)
+        params = model.init(jax.random.PRNGKey(0), self._tokens[:1, :8])
+        self.pipeline.register_model("lm", model, params=params, verbose=False)
+        self.pipeline.register_optimizer("adamw", optax.adamw(1e-4))
+        device_tokens = jax.device_put(self._tokens)
+        self.pipeline.register_dataset(
+            "train", [device_tokens] * (WARMUP_STEPS + TIMED_STEPS), verbose=False
+        )
+
+    def step(self, state, batch):
+        from dmlcloud_tpu.models.transformer import lm_loss
+
+        return lm_loss(state.apply_fn({"params": state.params}, batch), batch)
+
+    def val_epoch(self):  # throughput bench: train only
+        pass
+
+
+def bench_lm_framework(b=8, s=1024, layers=12, vocab=32000) -> float:
+    """Tokens/s of the same LM config as bench_lm, through the full
+    framework path. vs bench_lm's raw loop == the framework overhead for
+    transformer users."""
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, vocab, (b, s)), jnp.int32)
+    pipeline = dml.TrainingPipeline(name="bench-lm")
+    stage = LMBenchStage(tokens, s, layers, vocab)
+    pipeline.append_stage(stage, max_epochs=1)
+    marks = _instrument_stage(stage)
+    pipeline.run()
+    return TIMED_STEPS * b * s / (marks[1] - marks[0])
+
+
+def bench_decode(b=8, prompt_len=128, new_tokens=512, layers=12, vocab=32000, reps=3):
+    """Greedy decode throughput (generated tokens/s): chunked-attend cache
+    (attention cost scales with fill, models/generate.py). One compile, then
+    best-of-reps timed runs."""
+    from dmlcloud_tpu.models.generate import generate
+
+    model, cfg = _lm_model(s=prompt_len + new_tokens, layers=layers, vocab=vocab)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, vocab, (b, prompt_len)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt[:1, :8])["params"]
+    np.asarray(generate(model, params, prompt, new_tokens))  # compile + sync
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(generate(model, params, prompt, new_tokens))  # value fetch = sync
+        best = min(best, time.perf_counter() - t0)
+    return b * new_tokens / best
 
 
 def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
@@ -448,13 +517,30 @@ def child_main():
             print(f"child: framework bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         return out
 
+    smoke = bool(os.environ.get("DML_BENCH_SMOKE"))
+    lm_shape = dict(b=2, s=128, layers=2, vocab=512) if smoke else {}
+
+    def lm():
+        tps, mfu = bench_lm(iters=2 if smoke else 15, **lm_shape)
+        out = {"raw_tps": tps, "mfu": mfu, "fw_tps": None}
+        try:  # framework path measured separately so raw numbers survive
+            out["fw_tps"] = bench_lm_framework(**lm_shape)
+        except Exception as e:
+            errors.append(f"lm_framework: {type(e).__name__}: {e}")
+            print(f"child: lm framework bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return out
+
     _sub_bench(results, errors, "resnet", resnet)
-    if os.environ.get("DML_BENCH_SMOKE"):
+    if smoke:
         _sub_bench(results, errors, "flash", lambda: list(bench_flash(seq=512, b=1, h=2, iters=2)))
-        _sub_bench(results, errors, "lm", lambda: list(bench_lm(iters=2, b=2, s=128, layers=2, vocab=512)))
     else:
         _sub_bench(results, errors, "flash", lambda: list(bench_flash()))
-        _sub_bench(results, errors, "lm", lambda: list(bench_lm()))
+    _sub_bench(results, errors, "lm", lm)
+    if smoke:
+        _sub_bench(results, errors, "decode", lambda: bench_decode(
+            b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1))
+    else:
+        _sub_bench(results, errors, "decode", bench_decode)
     results["errors"] = errors
     results["peak_flops"] = chip_peak_flops()
     results["device_kind"] = jax.devices()[0].device_kind
@@ -534,7 +620,7 @@ def main():
     raw_ips = resnet.get("raw_ips")
     fw_ips = resnet.get("fw_ips")
     flash = tpu.get("flash") or [None, None, None]
-    lm = tpu.get("lm") or [None, None]
+    lm = tpu.get("lm") or {}
     value = fw_ips if fw_ips is not None else raw_ips
     print(
         json.dumps(
@@ -555,8 +641,13 @@ def main():
                     "flash_attn_tokens_per_sec_s8k": _rnd(flash[0], 1),
                     "flash_attn_speedup_vs_unfused_s8k": _rnd(flash[1], 3),
                     "flash_attn_window1k_speedup_vs_full_s8k": _rnd(flash[2], 3),
-                    "lm_train_tokens_per_sec_12l_768d_s1k": _rnd(lm[0], 1),
-                    "lm_train_mfu": _rnd(lm[1], 4),
+                    "lm_train_tokens_per_sec_12l_768d_s1k": _rnd(lm.get("raw_tps"), 1),
+                    "lm_train_mfu": _rnd(lm.get("mfu"), 4),
+                    "lm_framework_tokens_per_sec": _rnd(lm.get("fw_tps"), 1),
+                    "lm_vs_baseline": _rnd(
+                        lm["fw_tps"] / lm["raw_tps"] if lm.get("fw_tps") and lm.get("raw_tps") else None, 4
+                    ),
+                    "decode_tokens_per_sec_b8_p128_n512": _rnd(tpu.get("decode"), 1),
                     "metrics_allreduce_p50_ms_8proc_12metrics": _rnd(metrics_p50, 3),
                     "device_kind": tpu.get("device_kind"),
                     "bench_errors": tpu.get("errors") or (["tpu child never returned results"] if not tpu else []),

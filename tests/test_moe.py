@@ -3,10 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dmlcloud_tpu.models.moe import MoEConfig, MoEMLP, moe_partition_rules, total_aux_loss
 from dmlcloud_tpu.parallel import mesh as mesh_lib
-import pytest
 
 B, T, D = 2, 16, 8
 

@@ -3,9 +3,9 @@ returns the computed result, StepTimer percentiles behave."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dmlcloud_tpu.utils.profiling import StepTimer, profile_steps, trace
-import pytest
 
 
 @pytest.mark.slow
