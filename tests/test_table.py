@@ -3,6 +3,8 @@ tee-unwrapping that keeps log.txt free of carriage-return rewrites."""
 
 import io
 
+import pytest
+
 from dmlcloud_tpu.utils.table import ProgressTable
 
 
@@ -96,3 +98,90 @@ def test_tee_unwrapped_log_stays_clean():
     assert "0.3" in log.getvalue()  # final row did reach the log
     # and the header was printed exactly once
     assert log.getvalue().count("Epoch") == 1
+
+
+class TestColumnOptions:
+    """progress_table API pass-through: table_columns dicts may forward
+    color/alignment/aggregate (reference stage.py:113-130); unknown options
+    must be tolerated, not raise."""
+
+    def test_alignment_and_unknown_options_tolerated(self):
+        buf = io.StringIO()
+        t = ProgressTable(file=buf)
+        t.add_column("name", width=8, alignment="left", embedded_progress_bar=True)
+        t.add_column("val", width=8, alignment="center")
+        t["name"] = "ab"
+        t["val"] = 7
+        t.next_row()
+        row = [l for l in buf.getvalue().splitlines() if "ab" in l][0]
+        assert "│ ab      " in row  # left-aligned
+        assert f"   7    " in row  # centered
+
+    def test_aggregate_mean_and_sum(self):
+        buf = io.StringIO()
+        t = ProgressTable(file=buf)
+        t.add_column("loss", aggregate="mean")
+        t.add_column("count", aggregate="sum")
+        for v in (1.0, 2.0, 3.0):
+            t["loss"] = v
+            t["count"] = 2
+        assert t.row["loss"] == pytest.approx(2.0)
+        assert t.row["count"] == 6
+        t.next_row()
+        t["count"] = 5  # aggregation state resets per row
+        assert t.row["count"] == 5
+
+    def test_aggregate_min_max_ignore_the_count(self):
+        buf = io.StringIO()
+        t = ProgressTable(file=buf)
+        t.add_column("best", aggregate="max")
+        t.add_column("worst", aggregate="min")
+        for v in (0.8, 0.9):  # values below the running count
+            t["best"] = v
+            t["worst"] = v + 4
+        assert t.row["best"] == pytest.approx(0.9)
+        assert t.row["worst"] == pytest.approx(4.8)
+
+    def test_live_updates_never_pollute_aggregates(self):
+        buf = io.StringIO()
+        t = ProgressTable(file=buf)
+        t.add_column("loss", aggregate="mean")
+        t["loss"] = 1.0
+        t["loss"] = 2.0
+        t.live({"loss": 99.0})  # display-only
+        t["loss"] = 3.0
+        assert t.row["loss"] == pytest.approx(2.0)
+
+    def test_color_applies_to_live_only(self):
+        class Tty(io.StringIO):
+            def isatty(self):
+                return True
+
+        buf = Tty()
+        t = ProgressTable(file=buf)
+        t.add_column("loss", color="red")
+        t["loss"] = 1.0
+        t.live({"loss": 2.0})
+        assert "\x1b[31m" in buf.getvalue()  # live rewrite is colored
+        pos = len(buf.getvalue())
+        t["loss"] = 3.0  # real assignment replaces the live value
+        t.next_row()
+        final = buf.getvalue()[pos:]
+        assert "\x1b[" not in final  # committed row stays plain for log.txt
+        assert "3" in final
+
+    def test_stage_forwards_column_kwargs(self):
+        """A table_columns override written for progress_table (extra kwargs)
+        must flow through Stage._setup_table unchanged."""
+        buf = io.StringIO()
+        t = ProgressTable(file=buf)
+        cols = [{"name": "X", "metric": None, "color": "blue", "width": 12, "aggregate": "max"}]
+        for dct in cols:
+            dct = dict(dct)
+            name = dct.pop("name")
+            dct.pop("metric")
+            t.add_column(name, **dct)
+        t["X"] = 1
+        t["X"] = 9
+        t["X"] = 4
+        assert t.row["X"] == 9
