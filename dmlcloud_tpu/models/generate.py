@@ -46,6 +46,23 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int | None = No
     }
 
 
+def _chunked_scan(step, carry, first_step, n_total, attend_len_for_end):
+    """Run ``step(carry, i, attend_len=...)`` over steps
+    [first_step, first_step + n_total) as at most ``_DECODE_CHUNKS``
+    ``lax.scan`` segments; segment covering steps < end gets the static
+    ``attend_len_for_end(end)``. The single source of truth for decode
+    chunking — greedy/sampling and beam search share it (their index bases
+    differ by one, hence the callback). Returns (carry, per-segment ys)."""
+    chunk = -(-n_total // _DECODE_CHUNKS) if n_total else 1
+    ys = []
+    for start in range(first_step, first_step + n_total, chunk):
+        end = min(start + chunk, first_step + n_total)
+        seg_step = functools.partial(step, attend_len=attend_len_for_end(end))
+        carry, y = jax.lax.scan(seg_step, carry, jnp.arange(start, end))
+        ys.append(y)
+    return carry, ys
+
+
 def _sample(logits, rng, temperature: float, top_k: int, top_p: float):
     """logits: [B, V] fp32 -> tokens [B] int32."""
     if temperature == 0.0:
@@ -118,17 +135,10 @@ def _generate_compiled(
     # N-1 decode steps as a chain of scans (the Nth token needs only a
     # sample, not another forward pass): each scan segment attends over a
     # statically-bounded prefix that grows with the fill, so attention work
-    # totals O(N * (t + N/2)) instead of O(N * (t + N))
-    n_steps = max_new_tokens - 1
-    chunk = -(-n_steps // _DECODE_CHUNKS) if n_steps else 1
+    # totals O(N * (t + N/2)) instead of O(N * (t + N)).
+    # step i writes slot t + i, so the segment ending at `end` needs t + end.
     carry = (cache, last, rng, jnp.zeros((b,), bool))
-    chunks = []
-    for start in range(0, n_steps, chunk):
-        end = min(start + chunk, n_steps)
-        # last step in this segment writes slot t + end - 1
-        seg_step = functools.partial(step, attend_len=t + end)
-        carry, toks = jax.lax.scan(seg_step, carry, jnp.arange(start, end))
-        chunks.append(toks)
+    carry, chunks = _chunked_scan(step, carry, 0, max_new_tokens - 1, lambda end: t + end)
     cache, last, rng, done = carry
     final_tok, _ = sample_next(last, jax.random.split(rng)[1], done)
     tokens = jnp.concatenate(chunks + [final_tok[None]], axis=0)
@@ -235,12 +245,12 @@ def _beam_search_compiled(
     tokens = tokens.at[:, :, 0].set(tok)
     lengths = jnp.ones((b, k), jnp.int32)  # emitted tokens incl. eos
 
-    def step(carry, i):
+    def step(carry, i, attend_len):
         cache, tokens, scores, lengths, finished, last_tok = carry
         # last_tok was emitted at position t + i - 1; its K/V lands there
         logits, cache = model.apply(
             {"params": params}, last_tok.reshape(b * k, 1), cache=cache, offset=t + i - 1,
-            pad_len=pad_len_k,
+            pad_len=pad_len_k, attend_len=attend_len,
         )
         lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)).reshape(b, k, v)
         # finished beams may only extend with pad at no cost; everything else
@@ -253,28 +263,34 @@ def _beam_search_compiled(
         beam_idx = flat_idx // v  # which parent beam
         tok = (flat_idx % v).astype(jnp.int32)
 
-        # reorder per-beam state to follow the winning parents
+        # reorder per-beam state to follow the winning parents. Only the
+        # FILLED cache prefix needs the gather — unwritten tail slots are
+        # zeros on every beam, so reordering them would move identical data
+        def reorder_prefix(x):
+            pre = jax.lax.slice_in_dim(x, 0, attend_len, axis=1)
+            pre = jnp.take_along_axis(
+                pre.reshape(b, k, *pre.shape[1:]),
+                beam_idx.reshape(b, k, *([1] * (x.ndim - 1))),
+                axis=1,
+            ).reshape(b * k, *pre.shape[1:])
+            return jax.lax.dynamic_update_slice_in_dim(x, pre, 0, axis=1)
+
         take = lambda x: jnp.take_along_axis(x, beam_idx, axis=1)
         tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
         lengths, finished = take(lengths), take(finished)
-        cache = jax.tree_util.tree_map(
-            lambda x: jnp.take_along_axis(
-                x.reshape(b, k, *x.shape[1:]),
-                beam_idx.reshape(b, k, *([1] * (x.ndim - 1))),
-                axis=1,
-            ).reshape(b * k, *x.shape[1:]),
-            cache,
-        )
+        cache = jax.tree_util.tree_map(reorder_prefix, cache)
 
         tokens = tokens.at[:, :, i].set(tok)
         lengths = jnp.where(finished, lengths, lengths + 1)
         finished = finished | (tok == eos_id)
         return (cache, tokens, scores, lengths, finished, tok), None
 
-    init = (cache, tokens, scores, lengths, finished, tok)
-    (cache, tokens, scores, lengths, finished, _), _ = jax.lax.scan(
-        step, init, jnp.arange(1, max_new_tokens)
-    )
+    # chunked like generate(): each scan segment attends over (and gathers)
+    # a statically-bounded prefix that grows with the fill. Beam step i
+    # writes slot t + i - 1, so the segment ending at `end` needs t + end - 1.
+    carry = (cache, tokens, scores, lengths, finished, tok)
+    carry, _ = _chunked_scan(step, carry, 1, max_new_tokens - 1, lambda end: t + end - 1)
+    (cache, tokens, scores, lengths, finished, _) = carry
 
     # pick each row's best beam under GNMT-style length normalisation
     norm = scores / (lengths.astype(jnp.float32) ** length_penalty)

@@ -343,3 +343,19 @@ def test_attend_len_bounds_cache_reads():
     np.testing.assert_allclose(np.asarray(got), np.asarray(clean), rtol=1e-6, atol=1e-6)
     # the returned cache is still the FULL buffer (writes are never bounded)
     assert new_cache["layer_0"]["k"].shape[1] == 32
+
+
+@pytest.mark.slow
+def test_long_generation_exercises_multi_step_segments():
+    """max_new_tokens > _DECODE_CHUNKS forces scan segments longer than one
+    step, where attend_len runs AHEAD of the fill inside a segment — greedy
+    must still match the no-cache reference and single-beam greedy."""
+    from dmlcloud_tpu.models.generate import _DECODE_CHUNKS, beam_search
+
+    n = 2 * _DECODE_CHUNKS + 4  # segment length >= 3
+    model, params, prompt = _init(_tiny_cfg(max_seq_len=64), batch=2, t=6)
+    got = generate(model, params, prompt, max_new_tokens=n)
+    want = _greedy_no_cache(model, params, prompt, n)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    beam_toks, _ = beam_search(model, params, prompt, max_new_tokens=n, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beam_toks), np.asarray(got))
