@@ -15,6 +15,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -61,15 +62,23 @@ MODULES = [
 ]
 
 
+def _scrub(text: str) -> str:
+    """Object reprs embed per-process addresses (e.g. flax's _Sentinel
+    default: "<... object at 0x7f...>") — in signatures AND in dataclass
+    auto-docstrings. Scrub them or the page churns every interpreter run
+    and the CI staleness gate can never pass."""
+    return re.sub(r" at 0x[0-9a-fA-F]+", "", text)
+
+
 def _first_paragraph(obj) -> str:
     doc = inspect.getdoc(obj) or ""
     para = doc.split("\n\n", 1)[0].replace("\n", " ").strip()
-    return para
+    return _scrub(para)
 
 
 def _signature(obj) -> str:
     try:
-        sig = str(inspect.signature(obj))
+        sig = _scrub(str(inspect.signature(obj)))
     except (TypeError, ValueError):
         return ""
     return sig if len(sig) <= 110 else sig[:107] + "..."
