@@ -28,13 +28,12 @@ import subprocess
 import sys
 import time
 
-import numpy as np
-
 # NOTE: importing jax / dmlcloud_tpu does NOT initialize the TPU backend
 # (init is lazy, triggered by jax.devices()/first computation) — the parent
 # process relies on this to stay tunnel-independent.
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 import dmlcloud_tpu as dml
