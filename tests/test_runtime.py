@@ -152,3 +152,50 @@ class TestBarrierDiagnostics:
         client = _FakeClient()
         self._run_barrier(monkeypatch, client)
         assert any("/arrived/0" in k for k in client.kv)
+
+
+class TestInitLadder:
+    """init_auto's detection priority (reference util/distributed.py:227-244):
+    explicit env coordinator > TPU-pod metadata > Slurm > MPI > single —
+    initializers stubbed so no network or cluster is needed."""
+
+    def _stub(self, monkeypatch, chosen):
+        for name in ("init_from_env", "init_tpu_pod", "init_slurm", "init_mpi", "init_single"):
+            monkeypatch.setattr(runtime, name, lambda n=name, **kw: chosen.append(n))
+        monkeypatch.setattr(runtime._info, "initialized", False)
+
+    def test_tpu_pod_detection_requires_multiple_hosts(self, monkeypatch):
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        assert not runtime.has_tpu_pod_env()
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0")
+        assert not runtime.has_tpu_pod_env()  # single host: plain init
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1,host2,host3")
+        assert runtime.has_tpu_pod_env()
+
+    def test_explicit_coordinator_beats_tpu_pod(self, monkeypatch):
+        chosen = []
+        self._stub(monkeypatch, chosen)
+        monkeypatch.setenv("DMLCLOUD_TPU_COORDINATOR", "h:1")
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        runtime.init_auto()
+        assert chosen == ["init_from_env"]
+
+    def test_tpu_pod_beats_slurm(self, monkeypatch):
+        chosen = []
+        self._stub(monkeypatch, chosen)
+        monkeypatch.delenv("DMLCLOUD_TPU_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("SLURM_PROCID", "0")
+        runtime.init_auto()
+        assert chosen == ["init_tpu_pod"]
+
+    def test_fallback_is_single(self, monkeypatch):
+        chosen = []
+        self._stub(monkeypatch, chosen)
+        for var in ("DMLCLOUD_TPU_COORDINATOR", "JAX_COORDINATOR_ADDRESS",
+                    "TPU_WORKER_HOSTNAMES", "SLURM_PROCID"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setattr(runtime, "has_mpi", lambda: False)
+        runtime.init_auto()
+        assert chosen == ["init_single"]
