@@ -69,6 +69,10 @@ class TrainingPipeline:
         self._wandb_opts: dict | None = None
         self._wandb_timeout = 360
 
+        self._preempted = False
+        self._preemption_enabled = False
+        self._prev_signal_handlers: dict = {}
+
         self.stages: list[Stage] = []
         self.datasets: dict[str, Any] = {}
         self.models: dict[str, ModelEntry] = {}
@@ -312,6 +316,45 @@ class TrainingPipeline:
         """All-process barrier with timeout (reference pipeline.py:191-196)."""
         runtime.barrier("pipeline", timeout if timeout is not None else 600.0)
 
+    # -------------------------------------------------------- preemption
+    def enable_preemption_handling(self, signals: tuple[str, ...] = ("SIGTERM",)):
+        """Exit cleanly at the next epoch boundary when any of ``signals``
+        arrives on ANY rank (Cloud TPU preemption sends SIGTERM; Slurm jobs
+        typically arrange ``--signal=USR1@60`` -> pass ``("SIGUSR1",)``).
+
+        The epoch that just finished has already auto-saved its checkpoint,
+        and the stage is NOT marked stopped — so a requeued/restarted run
+        resumes at the next epoch instead of terminating for good. This is
+        TPU-side scope: the reference's fault model is Slurm requeue after
+        the fact (reference checkpoint.py:37-48) with no in-flight signal
+        handling."""
+        import signal as _signal
+
+        def handler(signum, frame):
+            # flag only — logging here could re-enter a buffered stream the
+            # signal interrupted; the normal control path reports the exit
+            self._preempted = True
+
+        # resolve every name BEFORE installing anything: a typo'd or
+        # platform-unsupported name must not leave a half-installed set
+        sigs = [getattr(_signal, name) for name in signals]
+        for sig in sigs:
+            prev = _signal.signal(sig, handler)
+            # re-enable on the same signal keeps the ORIGINAL disposition
+            # for _teardown, never our own closure
+            self._prev_signal_handlers.setdefault(sig, prev)
+        self._preempted = False  # a fresh arming forgets any earlier run's flag
+        self._preemption_enabled = True
+
+    def _preemption_coordinated(self) -> bool:
+        """Whether ANY rank caught a preemption signal — ranks must agree on
+        stopping or the survivors deadlock in the next collective."""
+        if not self._preemption_enabled:
+            return False
+        if runtime.world_size() <= 1:
+            return self._preempted
+        return any(runtime.all_gather_object(self._preempted))
+
     # ------------------------------------------------------------ lifecycle
     def run(self):
         """Run all registered stages sequentially."""
@@ -320,6 +363,11 @@ class TrainingPipeline:
             for stage in self.stages:
                 self.current_stage = stage
                 stage.run()
+                # the stage's own coordinated decision — already in lockstep
+                # across ranks, no extra collective needed here
+                if getattr(stage, "_preempt_exit", False):
+                    self.logger.info("preemption requested; skipping remaining stages")
+                    break
             self._post_run()
 
     # user hooks (reference pipeline.py:208-215)
@@ -419,6 +467,15 @@ class TrainingPipeline:
             wandb.finish(exit_code=0 if exc is None else 1)
         if self.io_redirector is not None:
             self.io_redirector.uninstall()
+        if self._prev_signal_handlers:
+            # restore process-wide dispositions: a stale handler would make
+            # post-run SIGTERM a silent no-op and pin this pipeline alive
+            import signal as _signal
+
+            for sig, prev in self._prev_signal_handlers.items():
+                _signal.signal(sig, prev)
+            self._prev_signal_handlers = {}
+            self._preemption_enabled = False
 
 
 @contextmanager

@@ -62,6 +62,7 @@ class Stage:
         self.epoch_stop_time = None
         self.current_epoch = 1
         self._stop_requested = False
+        self._preempt_exit = False
 
         self.metric_prefix = None
         self.table = None
@@ -147,7 +148,18 @@ class Stage:
         while not self._stop_requested and (self.max_epochs is None or self.current_epoch <= self.max_epochs):
             self._pre_epoch()
             self.run_epoch()
+            # decide BEFORE _post_epoch so its checkpoint save treats this
+            # epoch as final even under checkpoint_every() > 1
+            self._preempt_exit = self.pipeline._preemption_coordinated()
             self._post_epoch()
+            if self._preempt_exit:
+                # clean early exit WITHOUT _stop_requested: the epoch's
+                # checkpoint is saved and a requeued run resumes here
+                self.logger.info(
+                    f"preemption requested; stage {self.name!r} exiting cleanly after epoch "
+                    f"{self.current_epoch - 1} (resumable)"
+                )
+                break
         self._post_stage()
 
     def _pre_stage(self):
@@ -570,7 +582,7 @@ class TrainValStage(Stage):
         if ckpt is None or every <= 0 or self.state is None:
             return
         completed = self.current_epoch - 1  # super()._post_epoch incremented
-        final = completed == self.max_epochs or self._stop_requested
+        final = completed == self.max_epochs or self._stop_requested or self._preempt_exit
         if completed % every != 0 and not final:
             return
         save_kwargs = {}

@@ -343,3 +343,118 @@ def test_identical_policy_respecification_is_idempotent(tmp_path, single_runtime
     with pytest.raises(RuntimeError, match="already exists"):
         ckpt.state_manager("s", preservation_policy=ocm.AnyPreservationPolicy([ocm.LatestN(n=5)]))
     ckpt.close()
+
+
+class _PreemptAtEpoch(_ToyStage):
+    """Raises a (handled) preemption signal against our own process DURING a
+    chosen epoch (before its steps run) — models Cloud TPU/Slurm sending
+    SIGTERM/SIGUSR1 mid-training; the run exits after that epoch finishes."""
+
+    def __init__(self, signal_at_epoch: int):
+        super().__init__()
+        self._signal_at = signal_at_epoch
+
+    def pre_epoch(self):
+        if self.current_epoch == self._signal_at:
+            import os
+            import signal
+
+            os.kill(os.getpid(), signal.SIGUSR1)
+
+
+@pytest.mark.slow
+def test_preemption_exits_cleanly_and_resumes(tmp_path, single_runtime):
+    # run 1: signal arrives during epoch 2 of 5 -> clean exit, NOT stopped
+    p1 = dml.TrainingPipeline(name="toy")
+    s1 = _PreemptAtEpoch(signal_at_epoch=2)
+    p1.append_stage(s1, max_epochs=5, name="TrainValStage")
+    p1.enable_checkpointing(str(tmp_path / "p"))
+    p1.enable_preemption_handling(signals=("SIGUSR1",))
+    p1.run()
+    run_dir = str(p1.checkpoint_dir)
+    assert p1._preempted is True
+    assert s1.current_epoch == 3  # exactly two epochs completed
+    assert s1._stop_requested is False  # preemption != user stop
+    p1.checkpoint_dir.close()
+
+    # run 2 (the requeue): resumes at epoch 3 and finishes all 5
+    p2, s2 = _run(tmp_path / "p", resume_from=run_dir, max_epochs=5)
+    assert p2.resumed is True
+    assert s2.current_epoch == 6
+    assert len(p2.tracker["train/loss"]) == 5
+    p2.checkpoint_dir.close()
+
+    # equivalence with an uninterrupted control run
+    p3, s3 = _run(tmp_path / "q", max_epochs=5)
+    np.testing.assert_allclose(
+        np.asarray(s2.state.params["w"]), np.asarray(s3.state.params["w"]), rtol=1e-6, atol=1e-7
+    )
+    p3.checkpoint_dir.close()
+
+
+def test_preemption_skips_remaining_stages(tmp_path, single_runtime):
+    p = dml.TrainingPipeline(name="toy")
+    first = _PreemptAtEpoch(signal_at_epoch=1)
+    second = _ToyStage()
+    p.append_stage(first, max_epochs=2, name="first")
+    p.append_stage(second, max_epochs=2, name="second")
+    p.enable_checkpointing(str(tmp_path / "s"))
+    p.enable_preemption_handling(signals=("SIGUSR1",))
+    p.run()
+    assert first.current_epoch == 2  # exited after epoch 1
+    assert second.current_epoch == 1  # never ran an epoch
+    p.checkpoint_dir.close()
+
+
+@pytest.mark.slow
+def test_preemption_forces_save_despite_checkpoint_every(tmp_path, single_runtime):
+    """checkpoint_every() > 1 must not lose the preempted epoch: the
+    preemption exit is 'final' for the save decision."""
+    import signal
+
+    class SparseCkpt(_PreemptAtEpoch):
+        def checkpoint_every(self):
+            return 5
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    p1 = dml.TrainingPipeline(name="toy")
+    s1 = SparseCkpt(signal_at_epoch=2)
+    p1.append_stage(s1, max_epochs=9, name="TrainValStage")
+    p1.enable_checkpointing(str(tmp_path / "p"))
+    p1.enable_preemption_handling(signals=("SIGUSR1",))
+    p1.run()
+    assert p1.checkpoint_dir.latest_step(scope="TrainValStage") == 2  # forced save
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    # handler restored after the run (no stale process-wide disposition)
+    assert signal.getsignal(signal.SIGUSR1) == prev
+
+    p2 = dml.TrainingPipeline(name="toy")
+    s2 = _ToyStage()
+    p2.append_stage(s2, max_epochs=3, name="TrainValStage")
+    p2.enable_checkpointing(run_dir, resume=True)
+    p2.run()
+    assert s2.current_epoch == 4  # resumed at 3, finished 3
+    p2.checkpoint_dir.close()
+
+
+def test_preemption_rearming_is_safe(tmp_path, single_runtime):
+    """Double enable must keep the ORIGINAL disposition for restore, reset a
+    stale flag, and reject bad signal names before installing anything."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    p = dml.TrainingPipeline(name="toy")
+    p._preempted = True  # stale flag from a notional earlier run
+    p.enable_preemption_handling(signals=("SIGUSR1",))
+    p.enable_preemption_handling(signals=("SIGUSR1",))  # re-arm
+    assert p._preempted is False
+    assert p._prev_signal_handlers[signal.SIGUSR1] == prev  # original, not our closure
+    p._teardown(None)
+    assert signal.getsignal(signal.SIGUSR1) == prev
+
+    p2 = dml.TrainingPipeline(name="toy")
+    with pytest.raises(AttributeError):
+        p2.enable_preemption_handling(signals=("SIGUSR1", "SIGNOPE"))
+    # nothing half-installed: SIGUSR1's disposition is untouched
+    assert signal.getsignal(signal.SIGUSR1) == prev
