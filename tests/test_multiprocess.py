@@ -213,30 +213,21 @@ def test_fsdp_sharded_checkpoint_across_processes(tmp_path):
     )
 
 
-def test_pipeline_train_and_resume_two_processes(tmp_path):
-    """End-to-end: a 2-process pipeline (mesh spanning both processes' CPU
-    devices, global-batch step, Orbax collective checkpointing) trains 2
-    epochs; a second 2-process run resumes — with the resume sidecar
-    CORRUPTED, so both processes must take the root-broadcast degraded path
-    in lockstep (the divergence scenario that used to deadlock) — and
-    finishes at the same epoch on every rank."""
-    ckpt_root = tmp_path / "runs"
-    body = """
-    import json
+#: Shared worker-body fragment: a deterministic toy TrainValStage (linear
+#: regression, per-process data shard). Tests concatenate their specifics
+#: after it — one source of truth for the registration API in use.
+_TOY_STAGE = """
     import jax, jax.numpy as jnp, optax
     import dmlcloud_tpu as dml
-
-    CKPT = {ckpt!r}
-    RESUME = os.environ["RESUME_PHASE"] == "1"
 
     class Toy(dml.TrainValStage):
         def pre_stage(self):
             rng = np.random.RandomState(0)
             w = rng.randn(4, 1).astype(np.float32)
             xs = rng.randn(4, 8, 4).astype(np.float32)  # per-process shard
-            batches = [{{"x": jnp.asarray(x), "y": jnp.asarray(x @ w)}} for x in xs]
+            batches = [{"x": jnp.asarray(x), "y": jnp.asarray(x @ w)} for x in xs]
             self.pipeline.register_model(
-                "lin", apply_fn=lambda p, x: x @ p["w"], params={{"w": jnp.zeros((4, 1))}}, verbose=False
+                "lin", apply_fn=lambda p, x: x @ p["w"], params={"w": jnp.zeros((4, 1))}, verbose=False
             )
             self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
             self.pipeline.register_dataset("train", batches, verbose=False)
@@ -246,6 +237,22 @@ def test_pipeline_train_and_resume_two_processes(tmp_path):
 
         def val_epoch(self):
             pass
+"""
+
+
+def test_pipeline_train_and_resume_two_processes(tmp_path):
+    """End-to-end: a 2-process pipeline (mesh spanning both processes' CPU
+    devices, global-batch step, Orbax collective checkpointing) trains 2
+    epochs; a second 2-process run resumes — with the resume sidecar
+    CORRUPTED, so both processes must take the root-broadcast degraded path
+    in lockstep (the divergence scenario that used to deadlock) — and
+    finishes at the same epoch on every rank."""
+    ckpt_root = tmp_path / "runs"
+    body = _TOY_STAGE + """
+    import json
+
+    CKPT = {ckpt!r}
+    RESUME = os.environ["RESUME_PHASE"] == "1"
 
     pipeline = dml.TrainingPipeline(name="mp")
     stage = Toy()
@@ -330,3 +337,32 @@ def test_packed_flash_step_across_processes(tmp_path):
         assert parts[3] == "True", f"non-finite grads: {line[0]}"
     assert math.isfinite(losses[0])
     assert losses[0] == losses[1]  # the psum'd global loss is identical on both ranks
+
+
+def test_one_sided_preemption_coordinates_both_ranks(tmp_path):
+    """A preemption signal delivered to ONE rank only: both ranks must agree
+    to exit at the same epoch boundary (the un-signaled rank would otherwise
+    hang in the next epoch's collectives), save the checkpoint, and leave
+    the stage resumable (not stopped)."""
+    body = _TOY_STAGE + """
+    class PreemptToy(Toy):
+        def pre_epoch(self):
+            if RANK == 1 and self.current_epoch == 2:
+                import os as _os, signal as _signal
+                _os.kill(_os.getpid(), _signal.SIGUSR1)  # rank 1 ONLY
+
+    pipeline = dml.TrainingPipeline(name="mp-preempt")
+    stage = PreemptToy()
+    pipeline.append_stage(stage, max_epochs=5, name="stage")
+    pipeline.enable_checkpointing({ckpt!r})
+    pipeline.enable_preemption_handling(signals=("SIGUSR1",))
+    pipeline.run()
+    # saves committed: pipeline.run()'s _post_run waits on the checkpoint dir
+    assert stage.current_epoch == 3, stage.current_epoch  # both exit after epoch 2
+    assert stage._stop_requested is False
+    assert pipeline.checkpoint_dir.latest_step(scope="stage") == 2
+    print("PREEMPT-OK", RANK, stage.current_epoch)
+    """.replace("{ckpt!r}", repr(str(tmp_path / "runs")))
+    outs = _spawn(tmp_path, body, timeout=300)
+    for out in outs:
+        assert "PREEMPT-OK" in out
