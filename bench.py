@@ -310,7 +310,8 @@ def bench_decode(b=8, prompt_len=128, new_tokens=512, layers=12, vocab=32000, re
 
 def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
     """On-chip flash-kernel microbench: fused Pallas kernel vs the unfused
-    einsum path, fwd, causal. Returns (tokens/s, speedup_vs_dot)."""
+    einsum path, causal. Returns (fwd tokens/s, fwd speedup_vs_dot,
+    window speedup, fwd+bwd speedup_vs_dot — the number training pays)."""
     from dmlcloud_tpu.ops.flash_attention import _reference_attention, flash_attention
 
     rng = np.random.RandomState(0)
@@ -330,12 +331,30 @@ def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
             best = min(best, (time.perf_counter() - t0) / iters)
         return best
 
-    t_flash = timed(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)))
-    t_dot = timed(jax.jit(lambda q, k, v: _reference_attention(q, k, v, True, 1.0 / np.sqrt(d))))
+    def grad_of(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+
+        return jax.grad(loss, argnums=0)
+
+    flash_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    dot_fn = lambda q, k, v: _reference_attention(q, k, v, True, 1.0 / np.sqrt(d))
+    t_flash = timed(jax.jit(flash_fn))
+    t_dot = timed(jax.jit(dot_fn))
     # sliding window at W=1024: stale K/V blocks are skipped + DMAs elided,
     # so this should approach full-flash-time x (W / S) as S grows
     t_win = timed(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, window=1024)))
-    return b * seq / t_flash, t_dot / t_flash, t_flash / t_win
+    # fwd+bwd: what a training step actually pays. Guarded separately — the
+    # UNFUSED backward materializes fp32 scores (~4 GB at S=8k) and can OOM
+    # where everything above fits; the banked fwd numbers must survive that.
+    fwdbwd_speedup = None
+    try:
+        t_flash_bwd = timed(jax.jit(grad_of(flash_fn)), reps=2)
+        t_dot_bwd = timed(jax.jit(grad_of(dot_fn)), reps=2)
+        fwdbwd_speedup = t_dot_bwd / t_flash_bwd
+    except Exception as e:  # noqa: BLE001
+        print(f"child: flash fwd+bwd timing failed: {type(e).__name__}: {e}", file=sys.stderr)
+    return b * seq / t_flash, t_dot / t_flash, t_flash / t_win, fwdbwd_speedup
 
 
 _METRICS_WORKER = """
@@ -618,7 +637,7 @@ def main():
     resnet = tpu.get("resnet") or {}
     raw_ips = resnet.get("raw_ips")
     fw_ips = resnet.get("fw_ips")
-    flash = tpu.get("flash") or [None, None, None]
+    flash = tpu.get("flash") or [None, None, None, None]
     lm = tpu.get("lm") or {}
     value = fw_ips if fw_ips is not None else raw_ips
     print(
@@ -640,6 +659,7 @@ def main():
                     "flash_attn_tokens_per_sec_s8k": _rnd(flash[0], 1),
                     "flash_attn_speedup_vs_unfused_s8k": _rnd(flash[1], 3),
                     "flash_attn_window1k_speedup_vs_full_s8k": _rnd(flash[2], 3),
+                    "flash_attn_fwdbwd_speedup_vs_unfused_s8k": _rnd(flash[3], 3),
                     "lm_train_tokens_per_sec_12l_768d_s1k": _rnd(lm.get("raw_tps"), 1),
                     "lm_train_mfu": _rnd(lm.get("mfu"), 4),
                     "lm_framework_tokens_per_sec": _rnd(lm.get("fw_tps"), 1),
