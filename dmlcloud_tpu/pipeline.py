@@ -47,6 +47,7 @@ class ModelEntry:
     params: Any
     policy: Any = "replicate"
     extras: Any = None  # non-trained collections (batch_stats, ...)
+    ema: Any = None  # EMA shadow published by a stage with ema_decay() > 0
 
 
 class TrainingPipeline:

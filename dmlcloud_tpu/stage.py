@@ -314,6 +314,25 @@ class TrainValStage(Stage):
         ``accum`` Python dispatches, stage.py:290-314.)"""
         return 1
 
+    def ema_decay(self) -> float:
+        """Per-step decay of an exponential moving average of the params,
+        kept as a fp32 shadow tree on the state (same shapes and shardings
+        as the params) and updated inside the one compiled train step; 0
+        disables, typical values are 0.999-0.9999. Validation runs on the
+        averaged params (see ``val_with_ema``), and the shadow rides
+        checkpoints and resume like every other state leaf.
+
+        The reference has no equivalent; torch users bolt on
+        ``swa_utils.AveragedModel``, which costs a separate full-model pass
+        per update on host-dispatched kernels."""
+        return 0.0
+
+    def val_with_ema(self) -> bool:
+        """Whether validation sees the EMA params instead of the raw ones
+        (only meaningful when ``ema_decay() > 0``; default True — evaluating
+        the average is the point of keeping it)."""
+        return True
+
     def model_name(self) -> str | None:
         """Which registered model this stage trains (None = the only one)."""
         return None
@@ -375,6 +394,7 @@ class TrainValStage(Stage):
             tx=tx,
             rng=jax.random.fold_in(self.pipeline.root_key, stage_index),
             extras=fresh(entry.extras) if entry.extras is not None else None,
+            ema=True if float(self.ema_decay()) > 0.0 else None,
             mesh=self.mesh,
             policy=entry.policy,
         )
@@ -395,6 +415,7 @@ class TrainValStage(Stage):
     def _build_train_step(self) -> Callable:
         clip = float(self.gradient_clip())
         accum = int(self.gradient_accumulation())
+        ema_decay = float(self.ema_decay())
 
         def train_step(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -422,6 +443,8 @@ class TrainValStage(Stage):
                 scale = jnp.minimum(1.0, clip * gnorm)
                 grads = jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), grads)
             new_state = state.apply_gradients(grads).replace(extras=new_extras)
+            if ema_decay > 0.0:
+                new_state = new_state.update_ema(ema_decay)
             metrics = dict(metrics)
             metrics[self.loss_metric_name()] = loss
             return new_state, metrics
@@ -485,7 +508,13 @@ class TrainValStage(Stage):
         return loss_acc / accum, metrics, extras, grads
 
     def _build_val_step(self) -> Callable:
+        use_ema = float(self.ema_decay()) > 0.0 and self.val_with_ema()
+
         def val_step(state: TrainState, batch):
+            if use_ema:
+                # evaluate the averaged weights: the user's val_step reads
+                # state.params as usual and sees the EMA tree
+                state = state.replace(params=state.ema)
             out = self.val_step(state, batch)
             # same contract as train: loss | (loss, metrics) | (loss, metrics, extras);
             # extras are discarded in eval (no state update).
@@ -562,6 +591,9 @@ class TrainValStage(Stage):
             entry = self.pipeline._model_entry(self.model_name())
             entry.params = self.state.params
             entry.extras = self.state.extras
+            # the averaged weights are what the val metrics (and any
+            # best-checkpoint ranking) were computed on — hand them onward too
+            entry.ema = self.state.ema
         super()._post_stage()
 
     # -- automatic state checkpointing (closes reference gap, SURVEY.md §3.5) --
@@ -574,6 +606,8 @@ class TrainValStage(Stage):
         }
         if self.state.extras is not None:
             tree["extras"] = self.state.extras
+        if self.state.ema is not None:
+            tree["ema"] = self.state.ema
         return tree
 
     def _maybe_save_state(self):
@@ -681,8 +715,45 @@ class TrainValStage(Stage):
         latest = ckpt.latest_step(scope=self.name)
         if latest is None:
             return  # e.g. crash before this stage's first save
-        restored = ckpt.restore_state(latest, template=self._state_pytree(), scope=self.name)
+        template = self._state_pytree()
+        try:
+            restored = ckpt.restore_state(latest, template=template, scope=self.name)
+        except Exception as err:
+            # the one legitimate structure drift: ema_decay() toggled since
+            # the checkpoint was written. Retry with the other shape; any
+            # other mismatch re-raises the original error.
+            alt = {k: v for k, v in template.items() if k != "ema"}
+            if "ema" not in template:
+                alt["ema"] = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(
+                        x.shape,
+                        jnp.float32 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x.dtype,
+                    ),
+                    template["params"],
+                )
+            try:
+                restored = ckpt.restore_state(latest, template=alt, scope=self.name)
+            except Exception:
+                raise err from None
+            if "ema" in template:
+                self.logger.warning(
+                    f"Checkpoint {latest} for stage '{self.name}' has no EMA tree "
+                    "(ema_decay() was enabled after it was written); the shadow restarts "
+                    "from the restored params"
+                )
+            else:
+                self.logger.warning(
+                    f"Checkpoint {latest} for stage '{self.name}' carries an EMA tree but "
+                    "ema_decay() is now 0; the shadow is dropped"
+                )
+                restored.pop("ema", None)
         self.state = self.state.replace(**restored)
+        if self.state.ema is not None and "ema" not in restored:
+            # EMA newly enabled on a resumed run: average from the restored
+            # params, not the random init the fresh state copied
+            from .train_state import ema_like
+
+            self.state = self.state.replace(ema=ema_like(self.state.params))
         # The root alone reads and validates the sidecar, then broadcasts the
         # resolved (epoch, stopped, tracker) — if every process read its own
         # copy, a corrupt/missing file on SOME hosts would leave them with

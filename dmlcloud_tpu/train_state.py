@@ -33,6 +33,12 @@ class TrainState(struct.PyTreeNode):
     #: sharded over ``data``, computing stats inside the jitted step with an
     #: ``axis_name`` psum gives synchronised statistics for free.
     extras: Any = None
+    #: optional exponential-moving-average shadow of ``params`` (same tree,
+    #: same shapes, same shardings). Maintained by ``update_ema`` inside the
+    #: compiled step; rides checkpoints like any other state leaf. The
+    #: reference has no equivalent — torch users reach for a sidecar
+    #: AveragedModel; here it is one fused tree_map in the step.
+    ema: Any = None
     apply_fn: Callable = struct.field(pytree_node=False, default=None)
     tx: optax.GradientTransformation = struct.field(pytree_node=False, default=None)
 
@@ -45,6 +51,7 @@ class TrainState(struct.PyTreeNode):
         tx: optax.GradientTransformation,
         rng: jax.Array | int = 0,
         extras: Any = None,
+        ema: Any = None,
         mesh: Mesh | None = None,
         policy: Any = "replicate",
     ) -> "TrainState":
@@ -54,9 +61,17 @@ class TrainState(struct.PyTreeNode):
         (DDP semantics), 'fsdp' (ZeRO-3), T5X-style rule list, or a callable.
         Optimizer slots that mirror a param (Adam moments) inherit its
         sharding; scalar slots are replicated.
+
+        ``ema=True`` starts the shadow average as a FLOAT32 copy of
+        ``params`` (the standard init — the average is immediately usable;
+        fp32 because a low-precision shadow quantises away the ``(1-d)*p``
+        increments that make an EMA an EMA); a pytree starts it explicitly
+        in its own dtypes.
         """
         if isinstance(rng, int):
             rng = jax.random.PRNGKey(rng)
+        if ema is True:
+            ema = ema_like(params)
         opt_state = tx.init(params)
         state = cls(
             step=jnp.zeros((), jnp.int32),
@@ -64,6 +79,7 @@ class TrainState(struct.PyTreeNode):
             opt_state=opt_state,
             rng=rng,
             extras=extras,
+            ema=ema,
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -79,7 +95,11 @@ class TrainState(struct.PyTreeNode):
         extras_sh = (
             mesh_lib.sharding_for(self.extras, mesh, policy) if self.extras is not None else None
         )
-        return self.replace(step=rep, params=param_sh, opt_state=opt_sh, rng=rep, extras=extras_sh)
+        # the EMA tree mirrors params exactly, so it inherits their shardings
+        ema_sh = param_sh if self.ema is not None else None
+        return self.replace(
+            step=rep, params=param_sh, opt_state=opt_sh, rng=rep, extras=extras_sh, ema=ema_sh
+        )
 
     def apply_gradients(self, grads: Any) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -89,6 +109,44 @@ class TrainState(struct.PyTreeNode):
             params=new_params,
             opt_state=new_opt_state,
         )
+
+    def update_ema(self, decay: float) -> "TrainState":
+        """Fold the current params into the EMA: ``ema = d*ema + (1-d)*p``.
+
+        Traced (runs inside the compiled step — one fused tree_map, no extra
+        HBM round trips). The blend always accumulates in float32, then casts
+        back to the EMA leaf's dtype: in bf16, decay >= 0.996 rounds to
+        exactly 1.0 and the whole update would silently vanish. (A bf16
+        SHADOW still quantises each store — keep the shadow fp32, as
+        ``create(ema=True)`` does, when params are low-precision.)
+        No-op when no EMA tree is attached."""
+        if self.ema is None:
+            return self
+        d = jnp.float32(decay)
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: (d * e.astype(jnp.float32) + (1.0 - d) * p.astype(jnp.float32)).astype(
+                e.dtype
+            ),
+            self.ema,
+            self.params,
+        )
+        return self.replace(ema=new_ema)
+
+
+def ema_like(params: Any) -> Any:
+    """A fresh fp32 EMA tree initialised from ``params``.
+
+    Float leaves are upcast to float32 (a low-precision shadow quantises
+    away the ``(1-d)*p`` increments); others copy as-is. Always COPIES —
+    an EMA that aliases a param buffer breaks the train step's donation."""
+    return jax.tree_util.tree_map(
+        lambda x: (
+            jnp.array(x, jnp.float32, copy=True)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else jnp.copy(x)
+        ),
+        params,
+    )
 
 
 def _opt_state_shardings(opt_state: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:

@@ -1,0 +1,206 @@
+"""Parameter EMA: shadow-average math, sharding inheritance, stage
+integration (update inside the compiled step, validation on the average),
+and checkpoint/resume round-trip. No reference counterpart — torch users
+bolt on ``swa_utils.AveragedModel``; here the shadow is a TrainState leaf."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.parallel import mesh as mesh_lib
+from dmlcloud_tpu.train_state import TrainState
+
+
+def test_update_ema_math():
+    params = {"w": jnp.full((4,), 2.0)}
+    state = TrainState.create(
+        apply_fn=lambda p, x: x, params=params, tx=optax.sgd(0.0), ema=True
+    )
+    # ema starts as a copy of params
+    np.testing.assert_allclose(state.ema["w"], 2.0)
+    state = state.replace(params={"w": jnp.full((4,), 10.0)})
+    state = state.update_ema(0.9)
+    np.testing.assert_allclose(state.ema["w"], 0.9 * 2.0 + 0.1 * 10.0, rtol=1e-6)
+    state = state.update_ema(0.9)
+    np.testing.assert_allclose(state.ema["w"], 0.9 * 2.8 + 0.1 * 10.0, rtol=1e-6)
+
+
+def test_update_ema_noop_without_tree():
+    state = TrainState.create(
+        apply_fn=lambda p, x: x, params={"w": jnp.ones(3)}, tx=optax.sgd(0.1)
+    )
+    assert state.ema is None
+    assert state.update_ema(0.9) is state
+
+
+def test_ema_true_makes_fp32_shadow_and_high_decay_still_moves():
+    """decay >= 0.996 rounds to exactly 1.0 in bf16 — the shadow must be fp32
+    and the blend must accumulate in fp32 or a bf16-params EMA silently
+    freezes at its init value."""
+    params = {"w": jnp.zeros((4,), jnp.bfloat16)}
+    state = TrainState.create(apply_fn=lambda p, x: x, params=params, tx=optax.sgd(0.0), ema=True)
+    assert state.ema["w"].dtype == jnp.float32
+    state = state.replace(params={"w": jnp.ones((4,), jnp.bfloat16)})
+    for _ in range(3):
+        state = state.update_ema(0.9995)
+    expected = 1.0 - 0.9995**3
+    np.testing.assert_allclose(np.asarray(state.ema["w"]), expected, rtol=1e-4)
+
+
+def test_ema_keeps_fp32_shadow_of_bf16_params():
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    state = TrainState.create(
+        apply_fn=lambda p, x: x,
+        params=params,
+        tx=optax.sgd(0.0),
+        ema={"w": jnp.ones((4,), jnp.float32)},
+    )
+    state = state.replace(params={"w": jnp.full((4,), 3.0, jnp.bfloat16)})
+    state = state.update_ema(0.5)
+    assert state.ema["w"].dtype == jnp.float32
+    np.testing.assert_allclose(state.ema["w"], 2.0, rtol=1e-6)
+
+
+def test_ema_sharding_mirrors_params():
+    mesh = mesh_lib.create_mesh({"data": 4, "model": 2})
+    rules = [("a/kernel", P(None, "model")), ("b/kernel", P("model", None))]
+    params = {"a": {"kernel": jnp.ones((8, 16))}, "b": {"kernel": jnp.ones((8, 16))}}
+    state = TrainState.create(
+        apply_fn=lambda p, x: x, params=params, tx=optax.adam(1e-3), ema=True,
+        mesh=mesh, policy=rules,
+    )
+    sh = state.shardings(mesh, rules)
+    assert sh.ema["a"]["kernel"].spec == P(None, "model")
+    assert sh.ema["b"]["kernel"].spec == P("model", None)
+    assert state.ema["a"]["kernel"].sharding.spec == P(None, "model")
+
+
+class _EmaStage(dml.TrainValStage):
+    """Linear-regression toy: val loss on EMA params differs measurably from
+    val loss on raw params while the average trails the moving params."""
+
+    def __init__(self, decay):
+        super().__init__()
+        self._decay = decay
+
+    def ema_decay(self):
+        return self._decay
+
+    def pre_stage(self):
+        import flax.linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1, use_bias=False)(x)
+
+        model = Lin()
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+        self.pipeline.register_model("lin", model, params=params, verbose=False)
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.3))
+        rng = np.random.RandomState(0)
+        xs = rng.randn(8, 16, 4).astype(np.float32)
+        batches = [{"x": x, "y": (x @ np.array([[1.0], [2.0], [3.0], [4.0]])).astype(np.float32)} for x in xs]
+        self.pipeline.register_dataset("train", batches, verbose=False)
+        self.pipeline.register_dataset("val", batches[:2], verbose=False)
+
+    def step(self, state, batch):
+        pred = state.apply_fn({"params": state.params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _run(decay, epochs=2):
+    pipe = dml.TrainingPipeline(name="ema-test")
+    stage = _EmaStage(decay)
+    pipe.append_stage(stage, max_epochs=epochs)
+    pipe.run()
+    return stage
+
+
+def test_stage_ema_trails_params_and_val_uses_it():
+    stage = _run(decay=0.95)
+    params = np.asarray(stage.state.params["Dense_0"]["kernel"]).ravel()
+    ema = np.asarray(stage.state.ema["Dense_0"]["kernel"]).ravel()
+    target = np.array([1.0, 2.0, 3.0, 4.0])
+    # params converge toward the target; the EMA trails strictly behind
+    assert np.linalg.norm(params - target) < np.linalg.norm(ema - target)
+    assert not np.allclose(ema, params)
+    # the validation metric was computed on the EMA params: recompute both
+    # losses by hand and check which one the tracker recorded
+    xs = np.asarray(stage.pipeline.datasets["val"][0]["x"])
+    ys = np.asarray(stage.pipeline.datasets["val"][0]["y"])
+
+    def loss_of(w):
+        return float(np.mean((xs @ w.reshape(4, 1) - ys) ** 2))
+
+    recorded = stage.tracker["val/loss"][-1]
+    # tracker averaged over the two val batches of the last epoch
+    xs2 = np.asarray(stage.pipeline.datasets["val"][1]["x"])
+    ys2 = np.asarray(stage.pipeline.datasets["val"][1]["y"])
+    ema_loss = 0.5 * (loss_of(ema) + float(np.mean((xs2 @ ema.reshape(4, 1) - ys2) ** 2)))
+    raw_loss = 0.5 * (
+        loss_of(params) + float(np.mean((xs2 @ params.reshape(4, 1) - ys2) ** 2))
+    )
+    assert abs(recorded - ema_loss) < 1e-5
+    assert abs(recorded - raw_loss) > 1e-7  # and NOT the raw-params loss
+
+
+def test_stage_without_ema_has_no_shadow():
+    stage = _run(decay=0.0)
+    assert stage.state.ema is None
+
+
+def test_ema_enabled_after_checkpoint_resumes_from_restored_params(tmp_path):
+    """Toggling ema_decay() on across a resume must not break restore; the
+    fresh shadow starts from the restored params, not the random init."""
+    pipe = dml.TrainingPipeline(name="ema-toggle")
+    pipe.enable_checkpointing(str(tmp_path), resume=False)
+    pipe.append_stage(_EmaStage(0.0), max_epochs=2)
+    pipe.run()
+    trained = np.asarray(pipe.stages[0].state.params["Dense_0"]["kernel"])
+
+    pipe2 = dml.TrainingPipeline(name="ema-toggle")
+    pipe2.enable_checkpointing(str(pipe.checkpoint_dir.path), resume=True)
+    stage2 = _EmaStage(0.9)
+    pipe2.append_stage(stage2, max_epochs=2)
+    pipe2.run()
+    assert stage2.state.ema is not None
+    # no new epochs ran (already complete), so the shadow equals the restored params
+    np.testing.assert_allclose(
+        np.asarray(stage2.state.ema["Dense_0"]["kernel"]), trained, rtol=1e-6
+    )
+
+
+def test_ema_disabled_after_checkpoint_drops_shadow(tmp_path):
+    pipe = dml.TrainingPipeline(name="ema-toggle-off")
+    pipe.enable_checkpointing(str(tmp_path), resume=False)
+    pipe.append_stage(_EmaStage(0.9), max_epochs=2)
+    pipe.run()
+
+    pipe2 = dml.TrainingPipeline(name="ema-toggle-off")
+    pipe2.enable_checkpointing(str(pipe.checkpoint_dir.path), resume=True)
+    stage2 = _EmaStage(0.0)
+    pipe2.append_stage(stage2, max_epochs=2)
+    pipe2.run()
+    assert stage2.state.ema is None
+
+
+def test_ema_checkpoint_resume(tmp_path):
+    pipe = dml.TrainingPipeline(name="ema-ckpt")
+    pipe.enable_checkpointing(str(tmp_path), resume=False)
+    stage = _EmaStage(0.9)
+    pipe.append_stage(stage, max_epochs=2)
+    pipe.run()
+    saved_ema = np.asarray(stage.state.ema["Dense_0"]["kernel"])
+
+    pipe2 = dml.TrainingPipeline(name="ema-ckpt")
+    pipe2.enable_checkpointing(str(pipe.checkpoint_dir.path), resume=True)
+    stage2 = _EmaStage(0.9)
+    pipe2.append_stage(stage2, max_epochs=2)  # already-complete: restore, no new epochs
+    pipe2.run()
+    np.testing.assert_allclose(
+        np.asarray(stage2.state.ema["Dense_0"]["kernel"]), saved_ema, rtol=1e-6
+    )
