@@ -382,18 +382,35 @@ for epoch in range({epochs}):
     t0 = time.perf_counter()
     tracker.next_epoch()
     times.append(time.perf_counter() - t0)
+
+# The reference's exchange, on the same control plane: per metric, one
+# object gather (emptiness consensus) + one numeric all-reduce — 2
+# collectives x 12 metrics per epoch (/root/reference/dmlcloud/metrics.py:121-141)
+# vs the tracker's ONE packed collective above.
+ref_times = []
+for epoch in range({epochs}):
+    rt.barrier("align_ref")
+    t0 = time.perf_counter()
+    for name in names:
+        gathered = rt.all_gather_object((name, False))
+        vals = rt.all_gather_array(np.asarray([float(epoch)], np.float32))
+        _ = float(np.mean(vals))
+    ref_times.append(time.perf_counter() - t0)
 if rt.rank() == 0:
     print("P50_MS", float(np.percentile(np.asarray(times[5:]) * 1e3, 50)), flush=True)
+    print("REF_P50_MS", float(np.percentile(np.asarray(ref_times[5:]) * 1e3, 50)), flush=True)
 """
 
 
 def bench_metrics_allreduce(n_procs=8, epochs=40):
     """p50 latency of the fused epoch-end metric exchange (12 metrics) across
     ``n_procs`` real coordinated processes on localhost (CPU backend — the
-    one-chip environment cannot host a multi-process TPU group). The
-    reference's equivalent cost is 2 collectives x 12 metrics
-    (/root/reference/dmlcloud/metrics.py:121-141); here it is ONE collective
-    total. Returns p50 in ms, or None if the group fails."""
+    one-chip environment cannot host a multi-process TPU group). The same
+    worker also times the reference's exchange pattern — 2 collectives per
+    metric per epoch (/root/reference/dmlcloud/metrics.py:121-141) — on the
+    same runtime, so the fused-vs-reference speedup is measured, not
+    claimed. Returns (fused_p50_ms, reference_pattern_p50_ms); either may be
+    None if the group fails."""
     import tempfile
 
     from dmlcloud_tpu.utils.tcp import find_free_port
@@ -423,24 +440,26 @@ def bench_metrics_allreduce(n_procs=8, epochs=40):
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
                 )
             )
-        p50 = None
+        p50 = ref_p50 = None
         try:
             for i, p in enumerate(procs):
                 try:
                     out, _ = p.communicate(timeout=300)
                 except subprocess.TimeoutExpired:
-                    return None
+                    return None, None
                 if p.returncode != 0:
-                    return None
+                    return None, None
                 if i == 0:
                     for line in out.splitlines():
                         if line.startswith("P50_MS "):
                             p50 = float(line.split()[1])
+                        elif line.startswith("REF_P50_MS "):
+                            ref_p50 = float(line.split()[1])
         finally:
             for q in procs:  # a failed rank must not orphan the rest in a barrier
                 if q.poll() is None:
                     q.kill()
-        return p50
+        return p50, ref_p50
 
 
 def _init_watchdog(timeout_s: int = None):
@@ -630,12 +649,12 @@ def main():
     # tunnel before spending up to ~30 min on the TPU child
     try:
         if os.environ.get("DML_BENCH_SMOKE"):
-            metrics_p50 = bench_metrics_allreduce(n_procs=2, epochs=10)
+            metrics_p50, metrics_ref_p50 = bench_metrics_allreduce(n_procs=2, epochs=10)
         else:
-            metrics_p50 = bench_metrics_allreduce()
+            metrics_p50, metrics_ref_p50 = bench_metrics_allreduce()
     except Exception as e:  # noqa: BLE001
         print(f"parent: metrics-allreduce bench failed: {type(e).__name__}: {e}", file=sys.stderr)
-        metrics_p50 = None
+        metrics_p50 = metrics_ref_p50 = None
     tpu = _run_tpu_child() or {}
 
     peak = tpu.get("peak_flops") or 197e12
@@ -673,6 +692,12 @@ def main():
                     ),
                     "decode_tokens_per_sec_b8_p128_n512": _rnd(tpu.get("decode"), 1),
                     "metrics_allreduce_p50_ms_8proc_12metrics": _rnd(metrics_p50, 3),
+                    "metrics_allreduce_p50_ms_8proc_12metrics_reference_pattern": _rnd(
+                        metrics_ref_p50, 3
+                    ),
+                    "metrics_exchange_speedup_vs_reference_pattern": _rnd(
+                        metrics_ref_p50 / metrics_p50 if metrics_p50 and metrics_ref_p50 else None, 2
+                    ),
                     "device_kind": tpu.get("device_kind"),
                     "bench_errors": tpu.get("errors") or (["tpu child never returned results"] if not tpu else []),
                 },

@@ -513,8 +513,13 @@ class TrainValStage(Stage):
         def val_step(state: TrainState, batch):
             if use_ema:
                 # evaluate the averaged weights: the user's val_step reads
-                # state.params as usual and sees the EMA tree
-                state = state.replace(params=state.ema)
+                # state.params as usual and sees the EMA tree, cast to the
+                # params' dtypes (the fp32 shadow must not silently promote
+                # a bf16 model's whole forward pass to fp32)
+                ema = jax.tree_util.tree_map(
+                    lambda e, p: e.astype(p.dtype), state.ema, state.params
+                )
+                state = state.replace(params=ema)
             out = self.val_step(state, batch)
             # same contract as train: loss | (loss, metrics) | (loss, metrics, extras);
             # extras are discarded in eval (no state update).
@@ -724,8 +729,11 @@ class TrainValStage(Stage):
             # other mismatch re-raises the original error.
             alt = {k: v for k, v in template.items() if k != "ema"}
             if "ema" not in template:
+                # abstract template leaves: no device allocation for a tree
+                # that exists only to satisfy the structure match (its
+                # restored arrays are dropped below)
                 alt["ema"] = jax.tree_util.tree_map(
-                    lambda x: jnp.zeros(
+                    lambda x: jax.ShapeDtypeStruct(
                         x.shape,
                         jnp.float32 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x.dtype,
                     ),

@@ -123,13 +123,15 @@ class TrainState(struct.PyTreeNode):
         if self.ema is None:
             return self
         d = jnp.float32(decay)
-        new_ema = jax.tree_util.tree_map(
-            lambda e, p: (d * e.astype(jnp.float32) + (1.0 - d) * p.astype(jnp.float32)).astype(
-                e.dtype
-            ),
-            self.ema,
-            self.params,
-        )
+
+        def blend(e, p):
+            # non-float leaves can't average (an int blend through fp32
+            # truncates back to its old value forever) — they track params
+            if not jnp.issubdtype(e.dtype, jnp.floating):
+                return p.astype(e.dtype)
+            return (d * e.astype(jnp.float32) + (1.0 - d) * p.astype(jnp.float32)).astype(e.dtype)
+
+        new_ema = jax.tree_util.tree_map(blend, self.ema, self.params)
         return self.replace(ema=new_ema)
 
 

@@ -64,6 +64,48 @@ def test_ema_keeps_fp32_shadow_of_bf16_params():
     np.testing.assert_allclose(state.ema["w"], 2.0, rtol=1e-6)
 
 
+def test_update_ema_non_float_leaves_track_params():
+    """An int leaf can't average (the fp32 blend truncates back to the old
+    value forever) — it must follow the params directly."""
+    params = {"w": jnp.ones((2,)), "steps": jnp.asarray([5], jnp.int32)}
+    state = TrainState.create(apply_fn=lambda p, x: x, params=params, tx=optax.sgd(0.0), ema=True)
+    assert state.ema["steps"].dtype == jnp.int32
+    state = state.replace(params={"w": jnp.ones((2,)), "steps": jnp.asarray([10], jnp.int32)})
+    state = state.update_ema(0.999)
+    np.testing.assert_array_equal(np.asarray(state.ema["steps"]), [10])
+
+
+def test_val_sees_ema_in_param_dtype():
+    """The fp32 shadow must be cast back to the params' dtype for eval — a
+    bf16 model's validation must not silently run fp32."""
+    seen = {}
+
+    class Probe(dml.TrainValStage):
+        def ema_decay(self):
+            return 0.9
+
+        def pre_stage(self):
+            params = {"w": jnp.ones((4, 1), jnp.bfloat16)}
+            self.pipeline.register_model(
+                "m", apply_fn=lambda v, x: x @ v["params"]["w"], params={"params": params},
+                verbose=False,
+            )
+            self.pipeline.register_optimizer("sgd", optax.sgd(0.01))
+            batch = {"x": np.ones((8, 4), np.float32)}
+            self.pipeline.register_dataset("train", [batch] * 2, verbose=False)
+            self.pipeline.register_dataset("val", [batch], verbose=False)
+
+        def step(self, state, batch):
+            seen.setdefault("dtypes", []).append(state.params["w"].dtype)
+            pred = state.apply_fn({"params": state.params}, batch["x"])
+            return jnp.mean(pred.astype(jnp.float32) ** 2)
+
+    pipe = dml.TrainingPipeline(name="ema-dtype")
+    pipe.append_stage(Probe(), max_epochs=1)
+    pipe.run()
+    assert all(dt == jnp.bfloat16 for dt in seen["dtypes"])
+
+
 def test_ema_sharding_mirrors_params():
     mesh = mesh_lib.create_mesh({"data": 4, "model": 2})
     rules = [("a/kernel", P(None, "model")), ("b/kernel", P("model", None))]
