@@ -6,6 +6,11 @@ The full interop loop in one script:
         --TrainingPipeline fine-tune (packed corpus, segment_ids)-->
         --KV-cache sampling--> --export--> HF state dict
 
+With ``--lora RANK`` the finetune trains rank-RANK adapters only (the
+frozen base rides state.extras; optimizer state is adapter-sized) and the
+sample/export steps run on the merged model — the peft workflow, three
+pure functions (models/lora.py).
+
 With no network access this demo builds a small randomly-initialised HF
 model in-process; point ``--hf-name`` at any local HF checkpoint directory
 to use real weights (same code path).
@@ -63,11 +68,21 @@ def byte_corpus(n_docs: int, vocab: int, seed: int = 0) -> list[np.ndarray]:
 
 
 class FinetuneStage(dml.TrainValStage):
-    def __init__(self, model, cfg, params, seq_len, batch_size, n_docs, lr):
+    def __init__(self, model, cfg, params, seq_len, batch_size, n_docs, lr, lora_rank=0):
         super().__init__()
         self.model, self.model_cfg = model, cfg
         self._params = params
         self._seq_len, self._bs, self._n_docs, self._lr = seq_len, batch_size, n_docs, lr
+        self._lora_rank = lora_rank
+
+    def trained_params(self):
+        """What downstream consumers (sampling, export) should load: the
+        raw trained params, or base+adapters merged when LoRA is on."""
+        if not self._lora_rank:
+            return self.state.params
+        from dmlcloud_tpu.models.lora import lora_merge
+
+        return lora_merge(self.state.extras["lora_base"], self.state.params)
 
     def pre_stage(self):
         rows = list(pack_sequences(byte_corpus(self._n_docs, self.model_cfg.vocab_size), self._seq_len))
@@ -81,9 +96,26 @@ class FinetuneStage(dml.TrainValStage):
         self.pipeline.register_dataset("train", batches)
         # partition rules shard params/optimizer state over fsdp/model axes
         # when the mesh has them; on a plain data mesh they fold to replicate
-        self.pipeline.register_model(
-            "lm", self.model, params={"params": self._params}, sharding=llama_partition_rules()
-        )
+        if self._lora_rank:
+            import jax
+
+            from dmlcloud_tpu.models.lora import lora_init, lora_size
+
+            adapters = lora_init(jax.random.PRNGKey(0), self._params, rank=self._lora_rank)
+            self.logger.info(f"LoRA rank {self._lora_rank}: {lora_size(adapters):,} trainable params")
+            # same partition rules as the full finetune: they shard the
+            # frozen base in extras over fsdp/model axes (the whole point of
+            # LoRA on big models); adapter leaves no rule matches fold to
+            # replicate, which at rank<=64 is what you want anyway
+            self.pipeline.register_model(
+                "lm", apply_fn=self.model.apply,
+                params={"params": adapters, "lora_base": self._params},
+                sharding=llama_partition_rules(),
+            )
+        else:
+            self.pipeline.register_model(
+                "lm", self.model, params={"params": self._params}, sharding=llama_partition_rules()
+            )
         self.pipeline.register_optimizer("adamw", optax.adamw(self._lr))
 
     def gradient_clip(self):
@@ -91,7 +123,12 @@ class FinetuneStage(dml.TrainValStage):
 
     def step(self, state, batch):
         toks, segs = batch[:, 0], batch[:, 1]
-        logits = state.apply_fn({"params": state.params}, toks, segment_ids=segs)
+        params = state.params
+        if self._lora_rank:
+            from dmlcloud_tpu.models.lora import lora_merge
+
+            params = lora_merge(state.extras["lora_base"], state.params)
+        logits = state.apply_fn({"params": params}, toks, segment_ids=segs)
         return lm_loss(logits, toks, segment_ids=segs)
 
 
@@ -106,6 +143,7 @@ def main():
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--sample", type=int, default=16)
     parser.add_argument("--export", type=str, default=None, help="path to save the exported HF state dict (.npz)")
+    parser.add_argument("--lora", type=int, default=0, metavar="RANK", help="train rank-RANK LoRA adapters instead of full params")
     args = parser.parse_args()
 
     import jax.numpy as jnp
@@ -129,7 +167,7 @@ def main():
     if args.mesh:
         axes = {k: int(v) for k, v in (kv.split("=") for kv in args.mesh.split(","))}
         pipeline.set_mesh(axes)
-    stage = FinetuneStage(model, cfg, params, args.seq_len, args.batch_size, args.n_docs, args.lr)
+    stage = FinetuneStage(model, cfg, params, args.seq_len, args.batch_size, args.n_docs, args.lr, lora_rank=args.lora)
     pipeline.append_stage(stage, max_epochs=args.epochs)
     pipeline.run()
 
@@ -146,7 +184,7 @@ def main():
         for r, p in enumerate(pieces):
             prompt[r, width - len(p) :] = p
             mask[r, width - len(p) :] = 1
-        out = generate(model, stage.state.params, prompt, max_new_tokens=args.sample, prompt_mask=mask)
+        out = generate(model, stage.trained_params(), prompt, max_new_tokens=args.sample, prompt_mask=mask)
         for p, cont in zip(pieces, np.asarray(out).tolist()):
             print(f"prompt {p.tolist()} -> {cont}")
 
@@ -157,7 +195,7 @@ def main():
             if runtime.rank() == 0:
                 print("--export is a single-process demo; skipping under multi-process runs")
         else:
-            sd = hf_state_dict_from_params(stage.state.params, cfg)
+            sd = hf_state_dict_from_params(stage.trained_params(), cfg)
             np.savez(args.export, **sd)
             print(f"exported HF state dict ({len(sd)} tensors) to {args.export}")
 
