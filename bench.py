@@ -296,21 +296,34 @@ def bench_lm_framework(b=8, s=1024, layers=12, vocab=32000) -> float:
 def bench_decode(b=8, prompt_len=128, new_tokens=512, layers=12, vocab=32000, reps=3):
     """Greedy decode throughput (generated tokens/s): chunked-attend cache
     (attention cost scales with fill, models/generate.py). One compile, then
-    best-of-reps timed runs."""
+    best-of-reps timed runs. Returns (bf16_tps, int8_weight_tps) — decode is
+    weight-bandwidth-bound, so int8 weight-only quantization (models/quant.py)
+    is measured on exactly the same generate call."""
     from dmlcloud_tpu.models.generate import generate
+    from dmlcloud_tpu.models.quant import quantize_tree
 
     model, cfg = _lm_model(s=prompt_len + new_tokens, layers=layers, vocab=vocab)
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, vocab, (b, prompt_len)), jnp.int32
     )
     params = model.init(jax.random.PRNGKey(0), prompt[:1, :8])["params"]
-    np.asarray(generate(model, params, prompt, new_tokens))  # compile + sync
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(generate(model, params, prompt, new_tokens))  # value fetch = sync
-        best = min(best, time.perf_counter() - t0)
-    return b * new_tokens / best
+
+    def timed(p):
+        np.asarray(generate(model, p, prompt, new_tokens))  # compile + sync
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(generate(model, p, prompt, new_tokens))  # value fetch = sync
+            best = min(best, time.perf_counter() - t0)
+        return b * new_tokens / best
+
+    tps = timed(params)
+    int8_tps = None
+    try:
+        int8_tps = timed(quantize_tree(params))
+    except Exception as e:  # quantized path must not cost the bf16 number
+        print(f"child: int8 decode bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+    return tps, int8_tps
 
 
 def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
@@ -579,10 +592,10 @@ def child_main():
         _sub_bench(results, errors, "flash", lambda: list(bench_flash()))
     _sub_bench(results, errors, "lm", lm)
     if smoke:
-        _sub_bench(results, errors, "decode", lambda: bench_decode(
-            b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1))
+        _sub_bench(results, errors, "decode", lambda: list(bench_decode(
+            b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1)))
     else:
-        _sub_bench(results, errors, "decode", bench_decode)
+        _sub_bench(results, errors, "decode", lambda: list(bench_decode()))
     results["errors"] = errors
     results["peak_flops"] = chip_peak_flops()
     results["device_kind"] = jax.devices()[0].device_kind
@@ -662,6 +675,7 @@ def main():
     raw_ips = resnet.get("raw_ips")
     fw_ips = resnet.get("fw_ips")
     flash = tpu.get("flash") or [None, None, None, None]
+    decode = tpu.get("decode") or [None, None]
     lm = tpu.get("lm") or {}
     value = fw_ips if fw_ips is not None else raw_ips
     print(
@@ -690,7 +704,11 @@ def main():
                     "lm_vs_baseline": _rnd(
                         lm["fw_tps"] / lm["raw_tps"] if lm.get("fw_tps") and lm.get("raw_tps") else None, 4
                     ),
-                    "decode_tokens_per_sec_b8_p128_n512": _rnd(tpu.get("decode"), 1),
+                    "decode_tokens_per_sec_b8_p128_n512": _rnd(decode[0], 1),
+                    "decode_tokens_per_sec_b8_p128_n512_int8_weights": _rnd(decode[1], 1),
+                    "decode_int8_speedup": _rnd(
+                        decode[1] / decode[0] if decode[0] and decode[1] else None, 3
+                    ),
                     "metrics_allreduce_p50_ms_8proc_12metrics": _rnd(metrics_p50, 3),
                     "metrics_allreduce_p50_ms_8proc_12metrics_reference_pattern": _rnd(
                         metrics_ref_p50, 3

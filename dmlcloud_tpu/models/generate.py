@@ -105,6 +105,12 @@ def _generate_compiled(
     pad_id: int,
 ):
     b, t = prompt.shape
+    # int8 weight-only params (models/quant.py) rehydrate INSIDE the
+    # compiled program: HBM keeps the int8 buffers, XLA fuses the dequant
+    # into each consumer. No-op for ordinary trees.
+    from .quant import dequant_tree
+
+    params = dequant_tree(params, model.cfg.dtype)
     # cache in the model's compute dtype so fp32 configs stay exact
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
 
@@ -229,6 +235,10 @@ def _beam_search_compiled(
     v = model.cfg.vocab_size
     neg = jnp.float32(-1e30)
 
+    # int8 weight-only params rehydrate in-program (see _generate_compiled)
+    from .quant import dequant_tree
+
+    params = dequant_tree(params, model.cfg.dtype)
     # Prefill once per batch row, then tile the cache across beams.
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
     logits, cache = model.apply(

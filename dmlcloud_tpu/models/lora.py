@@ -67,9 +67,9 @@ def default_match(path: str, leaf: Any) -> bool:
 
 def _paths(tree: Any) -> Any:
     """Tree of '/'-joined key paths, same structure as ``tree``."""
-    return jax.tree_util.tree_map_with_path(
-        lambda kp, _: "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp), tree
-    )
+    from ..parallel.mesh import path_str
+
+    return jax.tree_util.tree_map_with_path(lambda kp, _: path_str(kp), tree)
 
 
 def _as_matcher(match: Any) -> Callable[[str, Any], bool]:
@@ -140,3 +140,19 @@ def lora_merge(base: Any, adapters: Any, alpha: float = 16.0) -> Any:
 def lora_size(adapters: Any) -> int:
     """Trainable adapter parameter count (what the optimizer actually sees)."""
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(adapters))
+
+
+def lora_partition_rules(base_rules: list) -> list:
+    """Sharding rules for a LoRA setup: replicate the adapter factors, keep
+    ``base_rules`` for everything else (the frozen base in ``extras`` still
+    shards over fsdp/model axes — the point of LoRA on big models).
+
+    Needed because T5X-style rules match with ``re.search``: a base rule for
+    ``attn/q_proj/kernel`` also matches the adapter path
+    ``attn/q_proj/kernel/a``, which would pointlessly shard the rank-R
+    factor (R is rarely divisible by a mesh axis, and rank-dim tensor
+    parallelism buys collectives for no FLOPs). First-match-wins ordering
+    puts the adapter rule in front."""
+    from jax.sharding import PartitionSpec
+
+    return [(r"kernel/(a|b)$", PartitionSpec()), *base_rules]

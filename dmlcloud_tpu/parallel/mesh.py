@@ -133,7 +133,10 @@ def data_parallel_size(mesh: Mesh) -> int:
 # parameter sharding policies
 # ---------------------------------------------------------------------------
 
-def _path_str(path) -> str:
+def path_str(path) -> str:
+    """'/'-joined pytree key path (dict keys, attr names, sequence indices)
+    — the string that sharding rules, LoRA matchers, and quantization
+    matchers all run their regexes against."""
     parts = []
     for p in path:
         if hasattr(p, "key"):
@@ -236,7 +239,7 @@ def sharding_for(tree: Any, mesh: Mesh, policy: str | PartitionRules | Callable 
     ``jax.jit(in_shardings=...)`` or ``jax.device_put``."""
     fn = make_param_policy(policy)
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, fn(_path_str(path), leaf, mesh)), tree
+        lambda path, leaf: NamedSharding(mesh, fn(path_str(path), leaf, mesh)), tree
     )
 
 

@@ -103,14 +103,15 @@ class FinetuneStage(dml.TrainValStage):
 
             adapters = lora_init(jax.random.PRNGKey(0), self._params, rank=self._lora_rank)
             self.logger.info(f"LoRA rank {self._lora_rank}: {lora_size(adapters):,} trainable params")
-            # same partition rules as the full finetune: they shard the
-            # frozen base in extras over fsdp/model axes (the whole point of
-            # LoRA on big models); adapter leaves no rule matches fold to
-            # replicate, which at rank<=64 is what you want anyway
+            from dmlcloud_tpu.models.lora import lora_partition_rules
+
+            # lora_partition_rules: adapters replicate (rank dims should not
+            # shard), while the base rules still shard the frozen weights in
+            # extras over fsdp/model axes — the point of LoRA on big models
             self.pipeline.register_model(
                 "lm", apply_fn=self.model.apply,
                 params={"params": adapters, "lora_base": self._params},
-                sharding=llama_partition_rules(),
+                sharding=lora_partition_rules(llama_partition_rules()),
             )
         else:
             self.pipeline.register_model(

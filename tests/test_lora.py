@@ -77,6 +77,25 @@ def test_grads_flow_only_through_adapters():
     np.testing.assert_allclose(np.asarray(grads["dense"]["kernel"].a), 0.0)
 
 
+def test_lora_partition_rules_replicate_adapters_only():
+    from jax.sharding import PartitionSpec as P
+
+    from dmlcloud_tpu.models.lora import lora_partition_rules
+    from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.create_mesh({"data": 4, "model": 2})
+    base = {"attn": {"q_proj": {"kernel": jnp.ones((8, 16))}}}
+    adapters = lora_init(jax.random.PRNGKey(0), base, rank=2)
+    rules = lora_partition_rules([("attn/.*kernel", P(None, "model"))])
+    base_sh = mesh_lib.sharding_for(base, mesh, rules)
+    ad_sh = mesh_lib.sharding_for(adapters, mesh, rules)
+    # the base kernel still shards; its adapter factors replicate even
+    # though the base rule's regex also matches ".../kernel/a"
+    assert base_sh["attn"]["q_proj"]["kernel"].spec == P(None, "model")
+    assert ad_sh["attn"]["q_proj"]["kernel"].a.spec == P()
+    assert ad_sh["attn"]["q_proj"]["kernel"].b.spec == P()
+
+
 def _mlp_and_base():
     import flax.linen as nn
 
