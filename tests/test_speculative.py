@@ -1,0 +1,98 @@
+"""Speculative decoding must be EXACT: same tokens as plain greedy
+generate() on the target, whatever the draft proposes — a perfect draft
+(the target itself), a random draft (low acceptance), across k values,
+batch rows, and eos early-exit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.models.generate import generate
+from dmlcloud_tpu.models.speculative import speculative_generate
+from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+
+def _lm(layers, seed, vocab=48, s=96):
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=2, num_kv_heads=1, head_dim=8,
+        hidden_dim=16, mlp_dim=32, max_seq_len=s, dtype=jnp.float32,
+    )
+    model = DecoderLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, vocab, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), tokens)["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def models():
+    target, tparams = _lm(layers=2, seed=0)
+    draft, dparams = _lm(layers=1, seed=7)
+    return target, tparams, draft, dparams
+
+
+def test_random_draft_matches_plain_greedy(models):
+    target, tparams, draft, dparams = models
+    prompt = jnp.asarray(np.random.RandomState(1).randint(0, 48, (3, 10)), jnp.int32)
+    want = np.asarray(generate(target, tparams, prompt, max_new_tokens=20))
+    got = np.asarray(
+        speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=20, k=4)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_perfect_draft_matches_plain_greedy(models):
+    target, tparams, _, _ = models
+    prompt = jnp.asarray(np.random.RandomState(2).randint(0, 48, (2, 6)), jnp.int32)
+    want = np.asarray(generate(target, tparams, prompt, max_new_tokens=16))
+    got = np.asarray(
+        speculative_generate(target, tparams, target, tparams, prompt, max_new_tokens=16, k=3)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_k_values_all_exact(models, k):
+    target, tparams, draft, dparams = models
+    prompt = jnp.asarray(np.random.RandomState(3).randint(0, 48, (2, 7)), jnp.int32)
+    want = np.asarray(generate(target, tparams, prompt, max_new_tokens=15))
+    got = np.asarray(
+        speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=15, k=k)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_eos_early_exit_matches(models):
+    target, tparams, draft, dparams = models
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 48, (2, 6)), jnp.int32)
+    # find an eos id that actually occurs early in the greedy output so the
+    # early-exit path is exercised rather than vacuously skipped
+    plain = np.asarray(generate(target, tparams, prompt, max_new_tokens=14))
+    eos = int(plain[0, 2])
+    want = np.asarray(generate(target, tparams, prompt, max_new_tokens=14, eos_id=eos))
+    got = np.asarray(
+        speculative_generate(
+            target, tparams, draft, dparams, prompt, max_new_tokens=14, k=4, eos_id=eos
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quantized_target_runs(models):
+    from dmlcloud_tpu.models.quant import quantize_tree
+
+    target, tparams, draft, dparams = models
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 48, (1, 8)), jnp.int32)
+    got = np.asarray(
+        speculative_generate(
+            target, quantize_tree(tparams), draft, dparams, prompt, max_new_tokens=8, k=2
+        )
+    )
+    assert got.shape == (1, 8)
+
+
+def test_length_guard(models):
+    target, tparams, draft, dparams = models
+    prompt = jnp.zeros((1, 90), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=10, k=4)
