@@ -69,6 +69,8 @@ class TrainingPipeline:
         self.wandb = False
         self._wandb_opts: dict | None = None
         self._wandb_timeout = 360
+        self._tensorboard_dir: str | None = None
+        self._tb_writer = None
 
         self._preempted = False
         self._preemption_enabled = False
@@ -278,6 +280,19 @@ class TrainingPipeline:
         self._wandb_timeout = startup_timeout
         self.wandb = True
 
+    def enable_tensorboard(self, logdir: str | None = None):
+        """Write per-epoch tracker scalars as TensorBoard event files (the
+        writer itself is root-only; needs ``tensorboardX``). Default logdir:
+        ``<checkpoint_dir>/tb`` resolved at run start — alongside any
+        ``jax.profiler`` traces, so one ``tensorboard --logdir`` shows the
+        curves and the device timeline of the same run. A third
+        observability channel the reference lacks (console table + wandb
+        are the other two)."""
+        import tensorboardX  # noqa: F401 — surface a missing install at call time
+
+        self._tensorboard_dir = logdir if logdir is not None else "__checkpoint__"
+        return self
+
     @runtime.root_only
     def _start_wandb(self):
         import wandb as _wandb
@@ -405,6 +420,18 @@ class TrainingPipeline:
 
         if self.wandb:
             self._start_wandb()
+        if self._tensorboard_dir is not None and runtime.is_root():
+            from .utils.tensorboard import TensorBoardWriter
+
+            tb_dir = self._tensorboard_dir
+            if tb_dir == "__checkpoint__":
+                if self.checkpoint_dir is None:
+                    raise ValueError(
+                        "enable_tensorboard() without a logdir needs checkpointing enabled "
+                        "(the default logdir is <checkpoint_dir>/tb) — pass an explicit logdir"
+                    )
+                tb_dir = str(self.checkpoint_dir.path / "tb")
+            self._tb_writer = TensorBoardWriter(tb_dir)
 
         self.barrier(timeout=600)
         self.start_time = datetime.now()
@@ -453,9 +480,15 @@ class TrainingPipeline:
         pass
 
     def _post_epoch(self):
-        if self.wandb and runtime.is_root():
+        need = (self.wandb or self._tb_writer is not None) and runtime.is_root()
+        if need:
             metrics = {name: self.tracker[name][-1] for name in self.tracker if self.tracker[name]}
-            wandb.log(metrics)
+            if self.wandb:
+                wandb.log(metrics)
+            if self._tb_writer is not None:
+                # the stage's _reduce_metrics has already advanced the
+                # tracker, so the just-completed epoch is epoch - 1
+                self._tb_writer.log_epoch(metrics, epoch=self.tracker.epoch - 1)
 
     def _teardown(self, exc: BaseException | None) -> None:
         """Guaranteed teardown — runs whether the stages finished, raised, or
@@ -466,6 +499,9 @@ class TrainingPipeline:
             self.logger.error("=== run failed; traceback follows ===", exc_info=exc)
         if self.wandb and wandb_is_initialized():
             wandb.finish(exit_code=0 if exc is None else 1)
+        if self._tb_writer is not None:
+            self._tb_writer.close()
+            self._tb_writer = None
         if self.io_redirector is not None:
             self.io_redirector.uninstall()
         if self._prev_signal_handlers:

@@ -5,18 +5,25 @@ SURVEY.md §5.1): capture real XLA traces viewable in TensorBoard/Perfetto.
 - ``trace(logdir)``: context manager around ``jax.profiler`` — wrap any block
   (a few train steps) to record device timelines, HLO op breakdown, and memory.
 - ``profile_steps(fn, n, logdir)``: run a callable ``n`` times under a trace.
+- ``roofline(trace_dir)``: parse the trace's own per-op hardware counters
+  (hlo_category / flops / bytes_accessed) into a per-category roofline
+  table next to the chip's peaks — the analysis that settled whether the
+  ResNet bench was MXU- or HBM-bound (doc/performance.md §5).
 - ``StepTimer``: dispatch-to-dispatch wall timer with p50/p95 summaries, the
   host-side complement used by bench.py.
 """
 
 from __future__ import annotations
 
+import collections
+import glob
+import os
 import time
 from contextlib import contextmanager
 
 import numpy as np
 
-__all__ = ["trace", "profile_steps", "StepTimer"]
+__all__ = ["trace", "profile_steps", "roofline", "format_roofline", "StepTimer"]
 
 
 @contextmanager
@@ -46,6 +53,112 @@ def profile_steps(fn, n: int, logdir: str, *args, **kwargs):
             result = fn(*args, **kwargs)
         jax.block_until_ready(result)
     return result
+
+
+def _xplane_pb2():
+    # generated protos predate protobuf 5's C++ descriptor pool checks
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # tensorflow ships the xplane schema
+        raise ImportError(
+            "roofline analysis parses the trace's xplane.pb, which needs the "
+            "tensorflow package for the proto schema only"
+        ) from e
+    return xplane_pb2
+
+
+def _stat_value(plane, st):
+    """Decode an XStat across its value oneof (incl. uint64 and interned refs)."""
+    kind = st.WhichOneof("value")
+    if kind is None:
+        return None
+    if kind == "ref_value":  # string interned in stat_metadata
+        return plane.stat_metadata[st.ref_value].name
+    return getattr(st, kind)
+
+
+def roofline(trace_dir: str, steps: int = 1) -> tuple[dict, list[dict]]:
+    """Aggregate a ``jax.profiler`` trace by HLO category from the chip's own
+    op counters. Returns ``(peaks, rows)``: ``peaks`` has the device type and
+    hardware peaks (TFLOP/s, HBM GB/s); each row has ``category``,
+    ``time_frac``, ``ms_per_step``, ``tflops`` (achieved), ``gbps``
+    (achieved), ``n_per_step``. ``steps`` = timed steps inside the trace.
+
+    Counter conventions: ``flops`` counts multiply-add as TWO ops (the MFU
+    convention — compare against peak directly); ``bytes_accessed`` includes
+    VMEM-resident reads, so aggregates may exceed the HBM peak while per-op
+    numbers near it still identify bandwidth-bound ops."""
+    xplane_pb2 = _xplane_pb2()
+    paths = sorted(glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb")))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir} (not a jax.profiler trace dir?)")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    plane = next(
+        (
+            p
+            for p in xs.planes
+            if p.name.startswith("/device:TPU") and any(l.name == "XLA Ops" for l in p.lines)
+        ),
+        None,
+    )
+    if plane is None:
+        raise ValueError("no TPU device plane with an 'XLA Ops' line in this trace")
+
+    def stats_of(stats):
+        return {plane.stat_metadata[st.metadata_id].name: _stat_value(plane, st) for st in stats}
+
+    pstats = stats_of(plane.stats)
+    peaks = {
+        "device": pstats.get("device_type_string", "?"),
+        "peak_tflops": float(pstats.get("peak_teraflops_per_second", 0) or 0),
+        "peak_hbm_gbps": float(pstats.get("peak_hbm_bw_gigabytes_per_second", 0) or 0),
+    }
+    (ops_line,) = [l for l in plane.lines if l.name == "XLA Ops"]
+    agg = collections.defaultdict(lambda: [0.0, 0.0, 0.0, 0])  # ps, flops, bytes, n
+    for ev in ops_line.events:
+        s = stats_of(plane.event_metadata[ev.metadata_id].stats)
+        row = agg[s.get("hlo_category", "?")]
+        row[0] += ev.duration_ps
+        row[1] += float(s.get("flops", 0) or 0)
+        row[2] += float(s.get("bytes_accessed", 0) or 0)
+        row[3] += 1
+    total_ps = sum(v[0] for v in agg.values()) or 1.0
+    rows = [
+        {
+            "category": cat,
+            "time_frac": ps / total_ps,
+            "ms_per_step": ps / 1e9 / steps,
+            "tflops": fl / ps if ps else 0.0,  # flops/ps == TFLOP/s
+            "gbps": by / (ps / 1e12) / 1e9 if ps else 0.0,
+            "n_per_step": n // steps,
+        }
+        for cat, (ps, fl, by, n) in sorted(agg.items(), key=lambda kv: -kv[1][0])
+    ]
+    return peaks, rows
+
+
+def format_roofline(peaks: dict, rows: list[dict], min_frac: float = 0.001) -> str:
+    """Human-readable roofline table (what scripts/analyze_trace.py prints)."""
+    out = [
+        f"device: {peaks['device']}  peak {peaks['peak_tflops']:.0f} TF/s, "
+        f"HBM {peaks['peak_hbm_gbps']:.0f} GB/s",
+        f"{'category':<28}{'time%':>7}{'ms/step':>9}{'TFLOP/s':>9}{'GB/s':>8}{'n/step':>8}",
+    ]
+    for r in rows:
+        if r["time_frac"] < min_frac:
+            continue
+        out.append(
+            f"{r['category']:<28}{r['time_frac'] * 100:>6.1f}%{r['ms_per_step']:>8.2f}"
+            f"{r['tflops']:>9.1f}{r['gbps']:>8.0f}{r['n_per_step']:>8}"
+        )
+    total_ms = sum(r["ms_per_step"] for r in rows)
+    tf = sum(r["tflops"] * r["ms_per_step"] for r in rows) / total_ms if total_ms else 0.0
+    pct = f" ({tf / peaks['peak_tflops'] * 100:.0f}% of peak)" if peaks["peak_tflops"] else ""
+    out.append(f"total: {total_ms:.2f} ms/step on device; aggregate {tf:.1f} TFLOP/s{pct}")
+    return "\n".join(out)
 
 
 class StepTimer:

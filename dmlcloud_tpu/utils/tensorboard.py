@@ -1,0 +1,43 @@
+"""TensorBoard metrics sink — a third observability channel next to the
+console table and W&B (the reference has only those two; SURVEY.md §5.5).
+
+Writes per-epoch tracker scalars as TensorBoard event files via
+``tensorboardX`` (lazy-imported, optional — the same pattern as the wandb
+glue). Pairs naturally with the profiler: ``jax.profiler`` traces land in
+the same logdir, so one ``tensorboard --logdir`` shows curves AND the
+XProf timeline of the exact same run."""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["TensorBoardWriter", "tensorboard_available"]
+
+
+def tensorboard_available() -> bool:
+    try:
+        import tensorboardX  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class TensorBoardWriter:
+    """Root-only scalar writer over a tracker's per-epoch histories."""
+
+    def __init__(self, logdir: str):
+        from tensorboardX import SummaryWriter  # deferred: optional dependency
+
+        self._writer = SummaryWriter(str(logdir))
+
+    def log_epoch(self, metrics: dict[str, Any], epoch: int) -> None:
+        for name, value in metrics.items():
+            try:
+                self._writer.add_scalar(name, float(value), global_step=epoch)
+            except (TypeError, ValueError):
+                continue  # non-scalar tracked values stay console/wandb-only
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()

@@ -1,10 +1,8 @@
 """Roofline breakdown of a ``jax.profiler`` trace, by HLO category.
 
-Parses the xplane.pb a trace directory contains (the same data XProf's
-op-profile tab renders) and prints, per HLO category: share of device time,
-achieved TFLOP/s, and achieved GB/s — next to the chip's hardware peaks,
-which the xplane also records. This is how doc/performance.md §5's ResNet
-ledger was produced:
+Thin CLI over ``dmlcloud_tpu.utils.profiling.roofline`` (which parses the
+xplane.pb's own per-op counters — the same data XProf's op-profile tab
+renders). This is how doc/performance.md §5's ResNet ledger was produced:
 
     python scripts/tune_resnet.py --trace /tmp/tr
     python scripts/analyze_trace.py /tmp/tr --steps 30
@@ -21,97 +19,12 @@ Notes on the counters (they are the chip's own accounting, not estimates):
 Requires tensorflow (baked into this image) for the xplane proto only.
 """
 
-from __future__ import annotations
-
 import argparse
-import collections
-import glob
-import os
 import sys
 
-# the generated protos predate protobuf 5's C++ descriptor pool checks
-os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-
-def load_xspace(trace_dir: str):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    paths = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
-    if not paths:
-        sys.exit(f"no xplane.pb under {trace_dir} (is this a jax.profiler trace dir?)")
-    xs = xplane_pb2.XSpace()
-    with open(sorted(paths)[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    return xs
-
-
-def device_plane(xs):
-    for p in xs.planes:
-        if p.name.startswith("/device:TPU") and any(l.name == "XLA Ops" for l in p.lines):
-            return p
-    sys.exit("no TPU device plane with an 'XLA Ops' line in this trace")
-
-
-def _stat_value(plane, st):
-    """Decode an XStat across its value oneof (incl. uint64 and interned refs)."""
-    kind = st.WhichOneof("value")
-    if kind is None:
-        return None
-    if kind == "ref_value":  # string interned in stat_metadata
-        return plane.stat_metadata[st.ref_value].name
-    return getattr(st, kind)
-
-
-def plane_stats(plane) -> dict:
-    return {
-        plane.stat_metadata[st.metadata_id].name: _stat_value(plane, st) for st in plane.stats
-    }
-
-
-def analyze(trace_dir: str, steps: int):
-    plane = device_plane(load_xspace(trace_dir))
-    peaks = plane_stats(plane)
-    peak_tf = float(peaks.get("peak_teraflops_per_second", 0) or 0)
-    peak_hbm = float(peaks.get("peak_hbm_bw_gigabytes_per_second", 0) or 0)
-
-    def md_stats(md):
-        return {
-            plane.stat_metadata[st.metadata_id].name: _stat_value(plane, st) for st in md.stats
-        }
-
-    (ops_line,) = [l for l in plane.lines if l.name == "XLA Ops"]
-    agg = collections.defaultdict(lambda: [0.0, 0.0, 0.0, 0])  # ps, flops, bytes, n
-    for ev in ops_line.events:
-        s = md_stats(plane.event_metadata[ev.metadata_id])
-        row = agg[s.get("hlo_category", "?")]
-        row[0] += ev.duration_ps
-        row[1] += float(s.get("flops", 0) or 0)
-        row[2] += float(s.get("bytes_accessed", 0) or 0)
-        row[3] += 1
-
-    total_ps = sum(v[0] for v in agg.values())
-    total_fl = sum(v[1] for v in agg.values())
-    total_by = sum(v[2] for v in agg.values())
-    print(
-        f"device: {peaks.get('device_type_string', '?')}  "
-        f"peak {peak_tf:.0f} TF/s, HBM {peak_hbm:.0f} GB/s"
-    )
-    print(f"{'category':<28}{'time%':>7}{'ms/step':>9}{'TFLOP/s':>9}{'GB/s':>8}{'n/step':>8}")
-    for cat, (ps, fl, by, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-        if ps / total_ps < 0.001:
-            continue
-        print(
-            f"{cat:<28}{ps / total_ps * 100:>6.1f}%{ps / 1e9 / steps:>8.2f}"
-            # flops are counted over the events' own duration: flops/ps == TFLOP/s
-            f"{fl / ps if ps else 0:>9.1f}{by / (ps / 1e12) / 1e9 if ps else 0:>8.0f}"
-            f"{n // steps:>8}"
-        )
-    pct_peak = f" ({total_fl / total_ps / peak_tf * 100:.0f}% of peak)" if peak_tf else ""
-    print(
-        f"\ntotal: {total_ps / 1e9 / steps:.2f} ms/step on device; aggregate "
-        f"{total_fl / total_ps:.1f} TFLOP/s{pct_peak}, "
-        f"{total_by / (total_ps / 1e12) / 1e9:.0f} GB/s nominal"
-    )
+from dmlcloud_tpu.utils.profiling import format_roofline, roofline
 
 
 def main():
@@ -119,7 +32,8 @@ def main():
     ap.add_argument("trace_dir", help="directory passed to jax.profiler.trace")
     ap.add_argument("--steps", type=int, default=30, help="timed steps inside the trace")
     args = ap.parse_args()
-    analyze(args.trace_dir, args.steps)
+    peaks, rows = roofline(args.trace_dir, steps=args.steps)
+    print(format_roofline(peaks, rows))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+"""TensorBoard sink: per-epoch tracker scalars land in event files that
+TensorBoard's own reader parses back (third observability channel next to
+the console table and wandb)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.utils.tensorboard import tensorboard_available
+
+def _reader_available() -> bool:
+    try:
+        from tensorboard.backend.event_processing import event_accumulator  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not (tensorboard_available() and _reader_available()),
+    reason="tensorboardX (writer) or tensorboard (test reader) not installed",
+)
+
+
+class _TinyStage(dml.TrainValStage):
+    def pre_stage(self):
+        import flax.linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1, use_bias=False)(x)
+
+        model = Lin()
+        self.pipeline.register_model(
+            "lin", model, params=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4))),
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.1))
+        rng = np.random.RandomState(0)
+        xs = rng.randn(4, 16, 4).astype(np.float32)
+        self.pipeline.register_dataset(
+            "train", [{"x": x, "y": x.sum(1, keepdims=True)} for x in xs], verbose=False
+        )
+
+    def step(self, state, batch):
+        pred = state.apply_fn({"params": state.params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+
+def _read_scalars(logdir):
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    acc = EventAccumulator(str(logdir))
+    acc.Reload()
+    return {tag: [(e.step, e.value) for e in acc.Scalars(tag)] for tag in acc.Tags()["scalars"]}
+
+
+def test_scalars_written_per_epoch(tmp_path):
+    pipe = dml.TrainingPipeline(name="tb-test")
+    pipe.enable_tensorboard(str(tmp_path / "tb"))
+    pipe.append_stage(_TinyStage(), max_epochs=3)
+    pipe.run()
+    scalars = _read_scalars(tmp_path / "tb")
+    assert "train/loss" in scalars, sorted(scalars)
+    steps = [s for s, _ in scalars["train/loss"]]
+    assert steps == [1, 2, 3]
+    # values are the tracker's reduced per-epoch losses
+    hist = pipe.stages[0].tracker["train/loss"]
+    np.testing.assert_allclose([v for _, v in scalars["train/loss"]], hist, rtol=1e-6)
+
+
+def test_default_logdir_needs_checkpointing(tmp_path):
+    pipe = dml.TrainingPipeline(name="tb-test2")
+    pipe.enable_tensorboard()  # default dir = <checkpoint_dir>/tb
+    pipe.append_stage(_TinyStage(), max_epochs=1)
+    with pytest.raises(ValueError, match="checkpointing"):
+        pipe.run()
+
+
+def test_default_logdir_under_checkpoint_dir(tmp_path):
+    pipe = dml.TrainingPipeline(name="tb-test3")
+    pipe.enable_checkpointing(str(tmp_path), resume=False)
+    pipe.enable_tensorboard()
+    pipe.append_stage(_TinyStage(), max_epochs=2)
+    pipe.run()
+    tb_dir = pipe.checkpoint_dir.path / "tb"
+    scalars = _read_scalars(tb_dir)
+    assert "train/loss" in scalars and len(scalars["train/loss"]) == 2
