@@ -429,6 +429,23 @@ class MetricTracker:
         self.reduce_all(strict=False)
         self.epoch += 1
 
+    def fast_forward(self, epoch: int) -> None:
+        """Jump the tracker to ``epoch``, padding every history with None
+        for the skipped epochs (no-op when already there or past).
+
+        Used by mid-epoch step-save resume when the restored tracker
+        sidecar is older than the epoch being resumed (sparse
+        ``checkpoint_every``): the gap epochs trained in the interrupted
+        run but their reduced values were never persisted, so they appear
+        as None instead of shifting every later epoch's alignment."""
+        if epoch <= self.epoch:
+            return
+        for name in self.histories:
+            hist = self.histories[name]
+            while len(hist) < epoch - 1:
+                hist.append(None)
+        self.epoch = epoch
+
     def state_dict(self) -> dict:
         return {
             "epoch": self.epoch,

@@ -148,6 +148,17 @@ class Stage:
         while not self._stop_requested and (self.max_epochs is None or self.current_epoch <= self.max_epochs):
             self._pre_epoch()
             self.run_epoch()
+            if getattr(self, "_mid_epoch_exit", False):
+                # a step-granular save already persisted the state and a
+                # coordinated preemption cut the epoch short: exit WITHOUT
+                # _post_epoch — the partial epoch must not reduce metrics
+                # or be recorded as complete (resume continues inside it)
+                self._preempt_exit = True
+                self.logger.info(
+                    f"preemption requested; stage {self.name!r} exiting cleanly mid-epoch "
+                    f"{self.current_epoch} (state saved at the last step boundary; resumable)"
+                )
+                break
             # decide BEFORE _post_epoch so its checkpoint save treats this
             # epoch as final even under checkpoint_every() > 1
             self._preempt_exit = self.pipeline._preemption_coordinated()
@@ -266,6 +277,13 @@ class TrainValStage(Stage):
         self._policy: Any = "replicate"
         self._train_step_fn = None
         self._val_step_fn = None
+        #: batches of the CURRENT epoch to skip on a mid-epoch resume
+        #: (one-shot, set by _restore_state from a step-save sidecar)
+        self._resume_skip_steps = 0
+        #: set when a preemption poll at a step-save point cut the epoch
+        #: short: run_epoch skips val and Stage.run exits without treating
+        #: the partial epoch as complete
+        self._mid_epoch_exit = False
 
     # -- overridables (parity: reference stage.py:228-257) ------------------
     def train_dataset(self):
@@ -352,6 +370,26 @@ class TrainValStage(Stage):
         resumed pipeline continues bit-for-bit: params, optimizer state, rng,
         extras, metric histories, and the epoch counter are all restored."""
         return 1
+
+    def checkpoint_every_steps(self) -> int:
+        """Steps between mid-epoch state saves: every N steps the full
+        TrainState is saved collectively (separate Orbax scope keyed by the
+        global optimizer step, newest-only retention), the preemption flag
+        is polled so a preempted run exits within N steps instead of at the
+        epoch boundary, and a resume whose step save is fresher than the
+        last completed epoch continues MID-epoch by fast-forwarding the
+        train dataset past the consumed batches. 0 disables (the default).
+
+        Epoch-boundary checkpointing (``checkpoint_every``) loses the whole
+        current epoch on a crash or preemption — unacceptable when one
+        "epoch" is hours of LM pretraining. Mid-epoch resume requires
+        per-epoch deterministic iteration order (true for every pipeline
+        here, which seeds shuffles by epoch), and continues bit-for-bit.
+
+        Metrics caveat: the resumed epoch's tracked metrics cover only the
+        post-resume steps (partial reducer buffers are not checkpointed);
+        counters like ``misc/total_train_batches`` under-count that epoch."""
+        return 0
 
     def checkpoint_keep(self) -> int:
         """How many checkpoints the stage's Orbax manager retains."""
@@ -539,7 +577,15 @@ class TrainValStage(Stage):
         keep-best ranking) at first manager creation — before any
         save/restore touches the scope."""
         ckpt = self.pipeline.checkpoint_dir
-        if ckpt is None or int(self.checkpoint_every()) <= 0:
+        if ckpt is None:
+            return
+        # step-save scope first: it must get its newest-only retention even
+        # when the user pre-configured the EPOCH scope (early return below)
+        # or disabled epoch checkpointing outright
+        if int(self.checkpoint_every_steps()) > 0 and not ckpt.has_state_manager(self._steps_scope):
+            # crash/preemption insurance only — history lives in epoch saves
+            ckpt.state_manager(self._steps_scope, max_to_keep=1)
+        if int(self.checkpoint_every()) <= 0:
             return
         if ckpt.has_state_manager(self.name):
             return  # the user configured this scope in pre_stage; their options win
@@ -572,6 +618,12 @@ class TrainValStage(Stage):
         keep = None if opts else int(self.checkpoint_keep())  # policy owns retention when set
         ckpt.state_manager(self.name, max_to_keep=keep, **opts)
 
+    @property
+    def _steps_scope(self) -> str:
+        """Orbax scope for mid-epoch step saves (separate from the
+        epoch-keyed scope so step ids never collide with epoch numbers)."""
+        return f"{self.name}.steps"
+
     def _pre_stage(self):
         super()._pre_stage()
         if self.state is None:
@@ -579,7 +631,9 @@ class TrainValStage(Stage):
             self._policy = entry.policy
             self.state = self.make_state()
         self._configure_state_manager()
-        if self.pipeline.resumed and int(self.checkpoint_every()) > 0:
+        if self.pipeline.resumed and (
+            int(self.checkpoint_every()) > 0 or int(self.checkpoint_every_steps()) > 0
+        ):
             # manual mode (checkpoint_every()==0) owns its restore layout too
             self._restore_state()
         self._train_step_fn = self._build_train_step()
@@ -671,6 +725,49 @@ class TrainValStage(Stage):
                 if f.stem.isdigit() and int(f.stem) not in kept:
                     f.unlink(missing_ok=True)
 
+    def _save_step_state(self, epoch_step: int) -> None:
+        """Collective mid-epoch save keyed by the GLOBAL optimizer step, with
+        a root-written sidecar recording where inside which epoch it landed
+        (what a resume needs to fast-forward the data)."""
+        ckpt = self.pipeline.checkpoint_dir
+        gstep = int(jax.device_get(self.state.step))
+        ckpt.save_state(gstep, self._state_pytree(), scope=self._steps_scope)
+        if is_root():
+            import json
+
+            from .checkpoint import atomic_write_text
+
+            meta_dir = ckpt.path / "meta" / self._steps_scope
+            meta_dir.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(
+                meta_dir / f"{gstep}.json",
+                json.dumps({"epoch": self.current_epoch, "step_in_epoch": epoch_step}),
+            )
+            # retention lockstep with Orbax's COMMITTED saves: with async
+            # saves the previous checkpoint stays the latest committed one
+            # until the new save lands, so its sidecar must survive until
+            # then or a crash mid-commit would leave the only restorable
+            # step save without resume metadata
+            kept = set(ckpt.state_manager(self._steps_scope).all_steps()) | {gstep}
+            for f in meta_dir.glob("*.json"):
+                if f.stem.isdigit() and int(f.stem) not in kept:
+                    f.unlink(missing_ok=True)
+
+    def _read_step_resume_meta(self, gstep: int) -> dict | None:
+        """Root-only: the step-save sidecar, or None (degrade to epoch resume)."""
+        import json
+
+        meta_file = self.pipeline.checkpoint_dir.path / "meta" / self._steps_scope / f"{gstep}.json"
+        try:
+            raw = json.loads(meta_file.read_text())
+            return {"epoch": int(raw["epoch"]), "step_in_epoch": int(raw["step_in_epoch"])}
+        except Exception:
+            self.logger.warning(
+                f"No usable step-resume metadata at {meta_file}; resuming from the last "
+                "completed epoch instead"
+            )
+            return None
+
     def _read_resume_meta(self, step: int) -> dict | None:
         """Root-only: read + validate the JSON resume sidecar for ``step``.
         Returns None (with a logged warning) on a missing/corrupt/ill-typed
@@ -713,20 +810,15 @@ class TrainValStage(Stage):
             )
         return None
 
-    def _restore_state(self):
+    def _restore_tree(self, scope: str, key: int) -> dict:
+        """Restore the state pytree from ``scope``/``key``, tolerating the
+        one legitimate structure drift: ``ema_decay()`` toggled since the
+        checkpoint was written. Any other mismatch re-raises."""
         ckpt = self.pipeline.checkpoint_dir
-        if ckpt is None or self.state is None:
-            return
-        latest = ckpt.latest_step(scope=self.name)
-        if latest is None:
-            return  # e.g. crash before this stage's first save
         template = self._state_pytree()
         try:
-            restored = ckpt.restore_state(latest, template=template, scope=self.name)
+            return ckpt.restore_state(key, template=template, scope=scope)
         except Exception as err:
-            # the one legitimate structure drift: ema_decay() toggled since
-            # the checkpoint was written. Retry with the other shape; any
-            # other mismatch re-raises the original error.
             alt = {k: v for k, v in template.items() if k != "ema"}
             if "ema" not in template:
                 # abstract template leaves: no device allocation for a tree
@@ -740,21 +832,47 @@ class TrainValStage(Stage):
                     template["params"],
                 )
             try:
-                restored = ckpt.restore_state(latest, template=alt, scope=self.name)
+                restored = ckpt.restore_state(key, template=alt, scope=scope)
             except Exception:
                 raise err from None
             if "ema" in template:
                 self.logger.warning(
-                    f"Checkpoint {latest} for stage '{self.name}' has no EMA tree "
+                    f"Checkpoint {key} for scope '{scope}' has no EMA tree "
                     "(ema_decay() was enabled after it was written); the shadow restarts "
                     "from the restored params"
                 )
             else:
                 self.logger.warning(
-                    f"Checkpoint {latest} for stage '{self.name}' carries an EMA tree but "
+                    f"Checkpoint {key} for scope '{scope}' carries an EMA tree but "
                     "ema_decay() is now 0; the shadow is dropped"
                 )
                 restored.pop("ema", None)
+            return restored
+
+    def _restore_state(self):
+        ckpt = self.pipeline.checkpoint_dir
+        if ckpt is None or self.state is None:
+            return
+        # manual epoch checkpointing (checkpoint_every()==0) owns its scope's
+        # keys — they need not be epoch numbers, so only step saves are
+        # considered for automatic resume in that mode
+        latest = ckpt.latest_step(scope=self.name) if int(self.checkpoint_every()) > 0 else None
+        # a step-granular save mid-epoch may be fresher than the last
+        # completed epoch (its sidecar records the epoch it was inside)
+        step_meta = step_latest = None
+        if int(self.checkpoint_every_steps()) > 0:
+            step_latest = ckpt.latest_step(scope=self._steps_scope)
+            if step_latest is not None:
+                sm = self._read_step_resume_meta(step_latest) if is_root() else None
+                sm = runtime.broadcast_object(sm)
+                if sm is not None and sm["epoch"] > (latest or 0):
+                    step_meta = sm
+        if latest is None and step_meta is None:
+            return  # e.g. crash before this stage's first save
+        if step_meta is not None:
+            restored = self._restore_tree(self._steps_scope, step_latest)
+        else:
+            restored = self._restore_tree(self.name, latest)
         self.state = self.state.replace(**restored)
         if self.state.ema is not None and "ema" not in restored:
             # EMA newly enabled on a resumed run: average from the restored
@@ -768,22 +886,40 @@ class TrainValStage(Stage):
         # different epoch counters and stop flags, so some hosts enter the
         # epoch loop's collectives while others skip it: divergence, then
         # deadlock. Same root-decides pattern as enable_checkpointing.
-        meta = self._read_resume_meta(latest) if is_root() else None
-        meta = runtime.broadcast_object(meta)
+        if latest is not None:
+            meta = self._read_resume_meta(latest) if is_root() else None
+            meta = runtime.broadcast_object(meta)
+        else:
+            meta = None
         if meta is not None:
             if meta["tracker"] is not None:
                 self.tracker.load_state_dict(meta["tracker"])
             self.current_epoch = meta["epoch"] + 1
             # a stage that had already stopped early must not re-train
             self._stop_requested = meta["stopped"]
-        else:
+        elif latest is not None:
             self.current_epoch = latest + 1
-        self.logger.info(
-            f"Restored stage '{self.name}' state from epoch {latest}; continuing at epoch {self.current_epoch}"
-        )
+        if step_meta is not None:
+            self.current_epoch = step_meta["epoch"]
+            self._resume_skip_steps = step_meta["step_in_epoch"]
+            # sparse checkpoint_every (>1): the restored tracker may trail
+            # the resumed epoch — pad the gap (None entries) so every later
+            # epoch's metrics stay aligned with its epoch number
+            self.tracker.fast_forward(self.current_epoch)
+            self.logger.info(
+                f"Restored stage '{self.name}' from mid-epoch step save (global step "
+                f"{step_latest}); continuing epoch {self.current_epoch} at batch "
+                f"{self._resume_skip_steps}"
+            )
+        else:
+            self.logger.info(
+                f"Restored stage '{self.name}' state from epoch {latest}; continuing at epoch {self.current_epoch}"
+            )
 
     def run_epoch(self):
         self.train_epoch()
+        if self._mid_epoch_exit:
+            return  # preempted at a step boundary: no val on a partial epoch
         self.val_epoch()
 
     def _put(self, batch):
@@ -811,6 +947,22 @@ class TrainValStage(Stage):
             train_ds.set_epoch(self.current_epoch)
         elif hasattr(train_ds, "sampler") and hasattr(getattr(train_ds, "sampler"), "set_epoch"):
             train_ds.sampler.set_epoch(self.current_epoch)
+
+        # mid-epoch resume: fast-forward the deterministic per-epoch
+        # iteration past the batches the interrupted run already consumed
+        # (host-side skip — no device transfers for skipped batches)
+        skipped = self._resume_skip_steps
+        self._resume_skip_steps = 0
+        if skipped:
+            import itertools
+
+            train_ds = itertools.islice(iter(train_ds), skipped, None)
+            self.logger.info(
+                f"mid-epoch resume: skipping the first {skipped} batches of epoch {self.current_epoch}"
+            )
+        every_steps = int(self.checkpoint_every_steps())
+        if self.pipeline.checkpoint_dir is None:
+            every_steps = 0
 
         # Live console row (reference stage.py:188-205 UX): loss EMA and
         # steps/s update in place during the epoch. The EMA fetch trails the
@@ -843,6 +995,13 @@ class TrainValStage(Stage):
             last_metrics = metrics
 
             steps_done += 1
+            if every_steps and (skipped + steps_done) % every_steps == 0:
+                self._save_step_state(skipped + steps_done)
+                if self.pipeline._preemption_coordinated():
+                    # the save just above is the resume point; cut the epoch
+                    # here instead of finishing it (Stage.run handles exit)
+                    self._mid_epoch_exit = True
+                    break
             if live:
                 pending_losses.append(metrics.get(self.loss_metric_name()))
                 if len(pending_losses) > 2:
@@ -866,6 +1025,10 @@ class TrainValStage(Stage):
         # honest number users actually want from "step time".
         if last_metrics is not None:
             jax.block_until_ready(last_metrics)
+        if self._mid_epoch_exit:
+            # partial epoch: skip epoch-level metrics — the resumed run
+            # finishes the epoch and reduces over its remaining steps
+            return
         train_elapsed = time.perf_counter() - epoch_t0
         if steps_done:
             self.track("misc/train_step_avg_ms", train_elapsed / steps_done * 1e3, prefixed=False)

@@ -1,0 +1,181 @@
+"""Step-granular checkpointing: a preemption mid-epoch exits at the next
+step boundary with the state saved, the resumed run fast-forwards the data
+to the exact batch, and the final params are bit-identical to a run that
+was never interrupted (deterministic per-epoch data + rng folded by global
+step make the two trajectories the same computation)."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import dmlcloud_tpu as dml
+
+BATCHES_PER_EPOCH = 10
+SAVE_EVERY = 3
+
+
+def _make_batches():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(BATCHES_PER_EPOCH, 16, 4).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    return [{"x": x, "y": x @ w} for x in xs]
+
+
+class _PreemptAfter:
+    """List-like dataset that raises SIGUSR1 after yielding batch K — the
+    real preemption path (signal -> coordinated poll at the save point)."""
+
+    def __init__(self, batches, kill_after=None):
+        self._batches = batches
+        self._kill_after = kill_after
+        self.fired = False
+
+    def __iter__(self):
+        for i, b in enumerate(self._batches):
+            yield b
+            if self._kill_after is not None and not self.fired and i + 1 == self._kill_after:
+                self.fired = True
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+    def __len__(self):
+        return len(self._batches)
+
+
+class _Stage(dml.TrainValStage):
+    def __init__(self, dataset, every_steps=SAVE_EVERY):
+        super().__init__()
+        self._dataset = dataset
+        self._every = every_steps
+
+    def checkpoint_every_steps(self):
+        return self._every
+
+    def device_prefetch(self):
+        return 0  # keep batch consumption aligned with steps for the test
+
+    def pre_stage(self):
+        import flax.linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1, use_bias=False)(x)
+
+        model = Lin()
+        self.pipeline.register_model(
+            "lin", model, params=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4))),
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
+        self.pipeline.register_dataset("train", self._dataset, verbose=False)
+
+    def step(self, state, batch):
+        pred = state.apply_fn({"params": state.params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+
+def _run(tmp_path, dataset, epochs=2, every_steps=SAVE_EVERY, preemptible=False):
+    pipe = dml.TrainingPipeline(name="stepckpt")
+    pipe.enable_checkpointing(str(tmp_path), resume=True)
+    if preemptible:
+        pipe.enable_preemption_handling(("SIGUSR1",))
+    stage = _Stage(dataset, every_steps)
+    pipe.append_stage(stage, max_epochs=epochs)
+    pipe.run()
+    return pipe, stage
+
+
+def test_preempt_mid_epoch_then_resume_bit_identical(tmp_path):
+    batches = _make_batches()
+
+    # control: never interrupted
+    _, control = _run(tmp_path / "control", batches)
+    want = np.asarray(control.state.params["Dense_0"]["kernel"])
+    assert int(control.state.step) == 2 * BATCHES_PER_EPOCH
+
+    # interrupted: SIGUSR1 after batch 5 of epoch 1 -> the step-boundary
+    # poll at step 6 saves and exits mid-epoch
+    ds = _PreemptAfter(batches, kill_after=5)
+    pipe1, stage1 = _run(tmp_path / "run", ds, preemptible=True)
+    assert stage1._mid_epoch_exit and stage1._preempt_exit
+    assert int(stage1.state.step) == 6
+    # epoch 1 is NOT recorded as complete
+    assert pipe1.checkpoint_dir.latest_step(scope=stage1.name) is None
+    assert pipe1.checkpoint_dir.latest_step(scope=f"{stage1.name}.steps") == 6
+
+    # resume: finishes epoch 1 from batch 7 and runs epoch 2
+    pipe2, stage2 = _run(pipe1.checkpoint_dir.path, _PreemptAfter(batches))
+    assert int(stage2.state.step) == 2 * BATCHES_PER_EPOCH
+    got = np.asarray(stage2.state.params["Dense_0"]["kernel"])
+    np.testing.assert_array_equal(got, want)
+    # the resumed epoch's metrics covered the remaining 4 steps only
+    # (documented caveat), but both epochs are recorded
+    assert len(stage2.tracker["train/loss"]) == 2
+
+
+def test_completed_epoch_supersedes_older_step_save(tmp_path):
+    batches = _make_batches()
+    pipe, stage = _run(tmp_path, batches, epochs=1)
+    # the run completed epoch 1 (and left a step save from inside it)
+    assert pipe.checkpoint_dir.latest_step(scope=stage.name) == 1
+    assert pipe.checkpoint_dir.latest_step(scope=f"{stage.name}.steps") is not None
+
+    pipe2, stage2 = _run(pipe.checkpoint_dir.path, batches, epochs=1)
+    # nothing retrains: the epoch save wins over the stale mid-epoch save
+    assert stage2.current_epoch == 2
+    assert int(stage2.state.step) == BATCHES_PER_EPOCH
+
+
+def test_tracker_fast_forward_pads_gap_epochs():
+    from dmlcloud_tpu.metrics import MetricTracker, Reduction
+
+    tr = MetricTracker()
+    tr.register_metric("m", Reduction.MEAN)
+    tr.track("m", 1.0)
+    tr.next_epoch()  # epoch 1 recorded, now at 2
+    tr.fast_forward(5)
+    assert tr.epoch == 5
+    tr.track("m", 9.0)
+    tr.next_epoch()
+    # epoch-5 value lands at index 4; gap epochs are None
+    assert list(tr["m"]) == [1.0, None, None, None, 9.0]
+    tr.fast_forward(3)  # no-op backwards
+    assert tr.epoch == 6
+
+
+class _ManualEpochStage(_Stage):
+    def checkpoint_every(self):
+        return 0  # manual epoch checkpointing: step saves must still resume
+
+
+def test_step_only_mode_still_resumes(tmp_path):
+    batches = _make_batches()
+    ds = _PreemptAfter(batches, kill_after=5)
+    pipe = dml.TrainingPipeline(name="steponly")
+    pipe.enable_checkpointing(str(tmp_path), resume=True)
+    pipe.enable_preemption_handling(("SIGUSR1",))
+    stage = _ManualEpochStage(ds)
+    pipe.append_stage(stage, max_epochs=2)
+    pipe.run()
+    assert int(stage.state.step) == 6
+
+    pipe2 = dml.TrainingPipeline(name="steponly")
+    pipe2.enable_checkpointing(str(pipe.checkpoint_dir.path), resume=True)
+    stage2 = _ManualEpochStage(_PreemptAfter(batches))
+    pipe2.append_stage(stage2, max_epochs=2)
+    pipe2.run()
+    # resumed mid-epoch from the step save despite checkpoint_every()==0
+    assert int(stage2.state.step) == 2 * BATCHES_PER_EPOCH
+
+
+def test_step_saves_disabled_by_default(tmp_path):
+    batches = _make_batches()
+    pipe, stage = _run(tmp_path, batches, epochs=1, every_steps=0)
+    assert pipe.checkpoint_dir.latest_step(scope=f"{stage.name}.steps") is None
