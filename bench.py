@@ -64,23 +64,7 @@ if os.environ.get("DML_BENCH_SMOKE"):  # CPU smoke-test of the full plumbing
 #: tune_resnet.py trace), within 3% of 3 x 8.2e9.
 TRAIN_FLOPS_PER_IMAGE = 3 * 8.2e9
 
-#: bf16 peak by TPU generation (chip). Fallback 197e12 (v5e) when unknown.
-_PEAK_BF16 = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
-
-def chip_peak_flops() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in _PEAK_BF16.items():
-        if key in kind:
-            return peak
-    return 197e12
+from dmlcloud_tpu.utils.profiling import chip_peak_flops  # noqa: E402 — shared peak table
 
 
 def synthetic_batch(rng: np.random.RandomState, batch: int):

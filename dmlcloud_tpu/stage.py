@@ -351,6 +351,19 @@ class TrainValStage(Stage):
         the average is the point of keeping it)."""
         return True
 
+    def step_flops(self) -> float:
+        """Total FLOPs one optimizer step performs across the WHOLE mesh
+        (forward+backward for the global batch; multiply-add counts as 2 —
+        the convention hardware peaks use). Return a positive number and the
+        stage tracks ``misc/mfu`` each epoch from the measured per-step
+        wall clock and the mesh's aggregate chip peak
+        (``utils.profiling.chip_peak_flops``). 0 (default) disables.
+
+        Rules of thumb: transformer training ≈ ``6 * params * tokens_per_
+        batch`` (PaLM convention, embedding lookups excluded); ResNet-50 @
+        224² ≈ ``24.6e9 * images_per_batch`` (see bench.py)."""
+        return 0.0
+
     def model_name(self) -> str | None:
         """Which registered model this stage trains (None = the only one)."""
         return None
@@ -692,13 +705,8 @@ class TrainValStage(Stage):
                 save_kwargs["metrics"] = {best_metric: float(val)}
         ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
         if is_root():
-            import json
-
-            from .checkpoint import atomic_write_text
             from .utils.serialization import to_jsonable
 
-            meta_dir = ckpt.path / "meta" / self.name
-            meta_dir.mkdir(parents=True, exist_ok=True)
             try:
                 tracker_state = to_jsonable(self.tracker.state_dict())
             except TypeError as e:
@@ -710,20 +718,36 @@ class TrainValStage(Stage):
                     "metadata without metric history"
                 )
                 tracker_state = None
-            meta = {
-                "epoch": completed,
-                "stopped": self._stop_requested,
-                "tracker": tracker_state,
-            }
-            # atomic write: a preemption mid-write must not leave a truncated
-            # sidecar that breaks the very resume it exists for
-            atomic_write_text(meta_dir / f"{completed}.json", json.dumps(meta))
-            # keep sidecars in lockstep with Orbax retention (max_to_keep);
-            # *.pkl covers sidecars from the pre-JSON format
-            kept = set(ckpt.state_manager(self.name).all_steps()) | {completed}
-            for f in list(meta_dir.glob("*.json")) + list(meta_dir.glob("*.pkl")):
-                if f.stem.isdigit() and int(f.stem) not in kept:
-                    f.unlink(missing_ok=True)
+            self._write_resume_sidecar(
+                self.name,
+                completed,
+                {"epoch": completed, "stopped": self._stop_requested, "tracker": tracker_state},
+            )
+
+    def _write_resume_sidecar(self, scope: str, key: int, payload: dict) -> None:
+        """Root-side sidecar write + retention cleanup, shared by the epoch
+        and step save paths.
+
+        Atomic write: a preemption mid-write must not leave a truncated
+        sidecar that breaks the very resume it exists for. Cleanup keeps
+        sidecars in lockstep with Orbax's COMMITTED saves (``all_steps``):
+        with async saves the previous checkpoint stays the latest committed
+        one until the new save lands, so its sidecar must survive until
+        then — deleting by 'newest only' would strand the only restorable
+        save without resume metadata after a crash mid-commit. ``*.pkl``
+        covers sidecars from the pre-JSON format."""
+        import json
+
+        from .checkpoint import atomic_write_text
+
+        ckpt = self.pipeline.checkpoint_dir
+        meta_dir = ckpt.path / "meta" / scope
+        meta_dir.mkdir(parents=True, exist_ok=True)
+        atomic_write_text(meta_dir / f"{key}.json", json.dumps(payload))
+        kept = set(ckpt.state_manager(scope).all_steps()) | {key}
+        for f in list(meta_dir.glob("*.json")) + list(meta_dir.glob("*.pkl")):
+            if f.stem.isdigit() and int(f.stem) not in kept:
+                f.unlink(missing_ok=True)
 
     def _save_step_state(self, epoch_step: int) -> None:
         """Collective mid-epoch save keyed by the GLOBAL optimizer step, with
@@ -733,25 +757,11 @@ class TrainValStage(Stage):
         gstep = int(jax.device_get(self.state.step))
         ckpt.save_state(gstep, self._state_pytree(), scope=self._steps_scope)
         if is_root():
-            import json
-
-            from .checkpoint import atomic_write_text
-
-            meta_dir = ckpt.path / "meta" / self._steps_scope
-            meta_dir.mkdir(parents=True, exist_ok=True)
-            atomic_write_text(
-                meta_dir / f"{gstep}.json",
-                json.dumps({"epoch": self.current_epoch, "step_in_epoch": epoch_step}),
+            self._write_resume_sidecar(
+                self._steps_scope,
+                gstep,
+                {"epoch": self.current_epoch, "step_in_epoch": epoch_step},
             )
-            # retention lockstep with Orbax's COMMITTED saves: with async
-            # saves the previous checkpoint stays the latest committed one
-            # until the new save lands, so its sidecar must survive until
-            # then or a crash mid-commit would leave the only restorable
-            # step save without resume metadata
-            kept = set(ckpt.state_manager(self._steps_scope).all_steps()) | {gstep}
-            for f in meta_dir.glob("*.json"):
-                if f.stem.isdigit() and int(f.stem) not in kept:
-                    f.unlink(missing_ok=True)
 
     def _read_step_resume_meta(self, gstep: int) -> dict | None:
         """Root-only: the step-save sidecar, or None (degrade to epoch resume)."""
@@ -763,8 +773,8 @@ class TrainValStage(Stage):
             return {"epoch": int(raw["epoch"]), "step_in_epoch": int(raw["step_in_epoch"])}
         except Exception:
             self.logger.warning(
-                f"No usable step-resume metadata at {meta_file}; resuming from the last "
-                "completed epoch instead"
+                f"No usable step-resume metadata at {meta_file}; falling back (last "
+                "completed epoch if one exists, else weights-only step restore)"
             )
             return None
 
@@ -867,9 +877,14 @@ class TrainValStage(Stage):
                 sm = runtime.broadcast_object(sm)
                 if sm is not None and sm["epoch"] > (latest or 0):
                     step_meta = sm
-        if latest is None and step_meta is None:
+        # no epoch save to fall back on but a step save exists (step-only
+        # mode, or a crash before the first epoch completed) with unusable
+        # position metadata: restore the WEIGHTS rather than silently
+        # training from scratch into the same checkpoint dir
+        blind_step = latest is None and step_meta is None and step_latest is not None
+        if latest is None and step_meta is None and not blind_step:
             return  # e.g. crash before this stage's first save
-        if step_meta is not None:
+        if step_meta is not None or blind_step:
             restored = self._restore_tree(self._steps_scope, step_latest)
         else:
             restored = self._restore_tree(self.name, latest)
@@ -910,6 +925,12 @@ class TrainValStage(Stage):
                 f"Restored stage '{self.name}' from mid-epoch step save (global step "
                 f"{step_latest}); continuing epoch {self.current_epoch} at batch "
                 f"{self._resume_skip_steps}"
+            )
+        elif blind_step:
+            self.logger.warning(
+                f"Restored stage '{self.name}' WEIGHTS from step save {step_latest} but its "
+                "position metadata was unusable: the epoch loop restarts at epoch "
+                f"{self.current_epoch} on the restored state"
             )
         else:
             self.logger.info(
@@ -1032,6 +1053,24 @@ class TrainValStage(Stage):
         train_elapsed = time.perf_counter() - epoch_t0
         if steps_done:
             self.track("misc/train_step_avg_ms", train_elapsed / steps_done * 1e3, prefixed=False)
+            flops = float(self.step_flops())
+            if flops > 0:
+                from .utils.profiling import peak_flops_for_kind
+
+                kind = jax.local_devices()[0].device_kind
+                peak = peak_flops_for_kind(kind)
+                if peak is None:
+                    peak = 197e12
+                    if not getattr(self, "_warned_mfu_peak", False):
+                        self._warned_mfu_peak = True
+                        self.logger.warning(
+                            f"device kind {kind!r} is not in the bf16 peak table; "
+                            "misc/mfu uses the TPU v5e peak (197 TF/s) as a stand-in"
+                        )
+                peak_total = peak * int(self.mesh.devices.size)
+                self.track(
+                    "misc/mfu", flops * steps_done / train_elapsed / peak_total, prefixed=False
+                )
         self.table["it/s"] = steps_done / max(train_elapsed, 1e-9)
 
         for name, schedule in self.pipeline.schedulers.items():

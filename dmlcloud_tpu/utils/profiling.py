@@ -55,6 +55,38 @@ def profile_steps(fn, n: int, logdir: str, *args, **kwargs):
     return result
 
 
+#: bf16 peak FLOP/s by TPU device_kind substring (fallback: v5e's 197e12).
+#: The same table bench.py uses for its MFU lines.
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_for_kind(kind: str) -> float | None:
+    """Peak bf16 FLOP/s for a ``device_kind`` string, or None if unknown
+    (callers decide whether to fall back — an unknowing fallback turns MFU
+    numbers on non-TPU backends into nonsense)."""
+    kind = kind.lower()
+    for key, peak in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def chip_peak_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of ``device`` (default: the first local device);
+    unknown device kinds fall back to the v5e peak."""
+    import jax
+
+    kind = (device or jax.local_devices()[0]).device_kind
+    return peak_flops_for_kind(kind) or 197e12
+
+
 def _xplane_pb2():
     # generated protos predate protobuf 5's C++ descriptor pool checks
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")

@@ -1,0 +1,65 @@
+"""step_flops() -> automatic misc/mfu tracking from measured step time and
+the mesh's aggregate chip peak."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.utils.profiling import chip_peak_flops
+
+
+class _FlopsStage(dml.TrainValStage):
+    def step_flops(self):
+        return 1.0e9
+
+    def pre_stage(self):
+        import flax.linen as nn
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1, use_bias=False)(x)
+
+        model = Lin()
+        self.pipeline.register_model(
+            "lin", model, params=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4))),
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.1))
+        x = np.ones((16, 4), np.float32)
+        self.pipeline.register_dataset("train", [{"x": x, "y": x.sum(1, keepdims=True)}] * 4, verbose=False)
+
+    def step(self, state, batch):
+        pred = state.apply_fn({"params": state.params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+
+def test_mfu_tracked_per_epoch():
+    pipe = dml.TrainingPipeline(name="mfu-test")
+    stage = _FlopsStage()
+    pipe.append_stage(stage, max_epochs=2)
+    pipe.run()
+    hist = stage.tracker["misc/mfu"]
+    assert len(hist) == 2 and all(v is not None and v > 0 for v in hist)
+    # consistency: mfu == flops/step / step_time / total_peak
+    step_ms = stage.tracker["misc/train_step_avg_ms"][-1]
+    peak_total = chip_peak_flops() * int(pipe.mesh.devices.size)
+    expected = 1.0e9 / (step_ms / 1e3) / peak_total
+    np.testing.assert_allclose(hist[-1], expected, rtol=1e-6)
+
+
+def test_mfu_absent_when_disabled():
+    class Off(_FlopsStage):
+        def step_flops(self):
+            return 0.0
+
+    pipe = dml.TrainingPipeline(name="mfu-off")
+    stage = Off()
+    pipe.append_stage(stage, max_epochs=1)
+    pipe.run()
+    assert "misc/mfu" not in stage.tracker

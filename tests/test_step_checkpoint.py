@@ -175,6 +175,30 @@ def test_step_only_mode_still_resumes(tmp_path):
     assert int(stage2.state.step) == 2 * BATCHES_PER_EPOCH
 
 
+def test_corrupt_sidecar_step_only_mode_still_restores_weights(tmp_path):
+    """Step-only mode with an unusable sidecar must restore the weights
+    (epoch position lost, loop restarts) — not silently train from scratch."""
+    batches = _make_batches()
+    pipe = dml.TrainingPipeline(name="blind")
+    pipe.enable_checkpointing(str(tmp_path), resume=True)
+    pipe.enable_preemption_handling(("SIGUSR1",))
+    stage = _ManualEpochStage(_PreemptAfter(batches, kill_after=5))
+    pipe.append_stage(stage, max_epochs=2)
+    pipe.run()
+    assert int(stage.state.step) == 6
+
+    meta = pipe.checkpoint_dir.path / "meta" / f"{stage.name}.steps" / "6.json"
+    meta.write_text("{corrupt")
+
+    pipe2 = dml.TrainingPipeline(name="blind")
+    pipe2.enable_checkpointing(str(pipe.checkpoint_dir.path), resume=True)
+    stage2 = _ManualEpochStage(_PreemptAfter(batches))
+    pipe2.append_stage(stage2, max_epochs=2)
+    pipe2.run()
+    # restored global step 6, then re-ran BOTH epochs from their start
+    assert int(stage2.state.step) == 6 + 2 * BATCHES_PER_EPOCH
+
+
 def test_step_saves_disabled_by_default(tmp_path):
     batches = _make_batches()
     pipe, stage = _run(tmp_path, batches, epochs=1, every_steps=0)
