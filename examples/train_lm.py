@@ -108,6 +108,22 @@ class LMStage(dml.TrainValStage):
     def gradient_clip(self):
         return 1.0
 
+    def ema_decay(self):
+        return float(self.config.get("ema", 0.0))
+
+    def checkpoint_every_steps(self):
+        return int(self.config.get("save_every_steps", 0))
+
+    def step_flops(self):
+        # 6 * params * tokens per global batch (PaLM convention); reported
+        # as misc/mfu in the table/wandb/tensorboard
+        if not self.config.get("mfu", False):
+            return 0.0
+        import jax.tree_util as jtu
+
+        n_params = sum(int(x.size) for x in jtu.tree_leaves(self.state.params))
+        return 6.0 * n_params * self.config.batch_size * self.config.seq_len
+
     def step(self, state, batch):
         if self.config.get("pack", False):
             toks, segs = batch[:, 0], batch[:, 1]
@@ -132,6 +148,9 @@ def main():
     parser.add_argument("--remat", action="store_true", help="recompute blocks in the backward pass (long-context memory)")
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument("--ema", type=float, default=0.0, help="param EMA decay (0 off); validation uses the average")
+    parser.add_argument("--save-every-steps", type=int, default=0, help="mid-epoch step saves (resumable mid-epoch)")
+    parser.add_argument("--mfu", action="store_true", help="track misc/mfu from the 6ND estimate")
     parser.add_argument(
         "--sample", type=int, default=0, metavar="N",
         help="after training, greedy-decode N tokens from a corpus prompt (KV-cache generate)",
