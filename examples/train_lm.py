@@ -173,6 +173,9 @@ def main():
         "remat": args.remat,
         "window": args.window,
         "pack": args.pack,
+        "ema": args.ema,
+        "save_every_steps": args.save_every_steps,
+        "mfu": args.mfu,
         "seed": 0,
     }
     pipeline = dml.TrainingPipeline(config, name=f"lm-{args.preset}")
