@@ -366,3 +366,76 @@ def test_one_sided_preemption_coordinates_both_ranks(tmp_path):
     outs = _spawn(tmp_path, body, timeout=300)
     for out in outs:
         assert "PREEMPT-OK" in out
+
+
+def test_mid_epoch_step_save_and_resume_two_processes(tmp_path):
+    """Step-granular checkpointing across a REAL 2-process group: rank 0
+    alone sees a 'preemption' mid-epoch, the coordinated poll at the next
+    step-save boundary makes BOTH ranks save collectively (sharded Orbax
+    write) and exit mid-epoch; a second 2-process run resumes inside the
+    epoch and finishes with both ranks in agreement."""
+    ckpt_root = tmp_path / "runs"
+    body = _TOY_STAGE + """
+    CKPT = {ckpt!r}
+    RESUME = os.environ["RESUME_PHASE"] == "1"
+
+    class StepToy(Toy):
+        def checkpoint_every_steps(self):
+            return 2
+
+        def device_prefetch(self):
+            return 0  # keep batch consumption aligned with steps
+
+        def pre_stage(self):
+            super().pre_stage()
+            if not RESUME:
+                pipe = self.pipeline
+                batches = pipe.datasets["train"]
+
+                class Trigger:  # rank 0 'catches a signal' after batch 3
+                    def __iter__(self):
+                        for i, b in enumerate(batches):
+                            yield b
+                            if RANK == 0 and i + 1 == 3:
+                                pipe._preempted = True
+
+                    def __len__(self):
+                        return len(batches)
+
+                pipe.datasets["train"] = Trigger()
+
+    pipeline = dml.TrainingPipeline(name="mpstep")
+    if not RESUME:
+        pipeline._preemption_enabled = True
+        pipeline._preempted = False
+    stage = StepToy()
+    pipeline.append_stage(stage, max_epochs=2, name="stage")
+    pipeline.enable_checkpointing(CKPT, resume=RESUME)
+    pipeline.run()
+    if not RESUME:
+        assert stage._mid_epoch_exit and stage._preempt_exit
+        # the poll at step 4 (save cadence 2) cut epoch 1 short on BOTH ranks
+        assert int(stage.state.step) == 4, int(stage.state.step)
+    else:
+        assert int(stage.state.step) == 8, int(stage.state.step)
+        assert stage.current_epoch == 3, stage.current_epoch
+    fp = float(np.abs(np.asarray(stage.state.params["w"])).sum())
+    pipeline.checkpoint_dir.wait_until_finished()
+    print("STEP-PHASE-OK", RANK, round(fp, 6))
+    """.format(ckpt=str(ckpt_root))
+
+    env_marker = "\n    os.environ.setdefault('RESUME_PHASE', '0')\n"
+    os.environ["RESUME_PHASE"] = "0"
+    try:
+        outs = _spawn(tmp_path, env_marker + body, timeout=480)
+        run_dirs = [d for d in ckpt_root.iterdir() if d.is_dir()]
+        assert len(run_dirs) == 1
+        assert (run_dirs[0] / "state" / "stage.steps").exists()
+        os.environ["RESUME_PHASE"] = "1"
+        body_resume = body.replace("CKPT = ", f"CKPT = {str(run_dirs[0])!r}  # ")
+        outs = _spawn(tmp_path, env_marker + body_resume, timeout=480)
+        # both ranks ended on identical params
+        fps = {line.split()[-1] for out in outs for line in out.splitlines() if "STEP-PHASE-OK" in line}
+        assert len(fps) == 1, fps
+    finally:
+        os.environ.pop("RESUME_PHASE", None)
