@@ -9,6 +9,8 @@ Llama/Mistral checkpoint from examples/finetune_hf.py --export):
     python examples/generate_text.py --max-new 24
     python examples/generate_text.py --temperature 0.8 --top-p 0.9
     python examples/generate_text.py --beams 4
+    python examples/generate_text.py --int8          # weight-only int8 decode
+    python examples/generate_text.py --speculative 4 # draft-verified greedy
 """
 
 import argparse
@@ -22,19 +24,19 @@ from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 
 
 def build_model(args):
+    # speculative decoding writes up to k+1 proposal slots past the output
+    seq_len = args.prompt_len + args.max_new + (args.speculative + 1 if args.speculative else 0)
     if args.hf:
         import transformers
 
         from dmlcloud_tpu.models.hf import llama_params_from_hf, transformer_config_from_hf
 
         hf_model = transformers.LlamaForCausalLM.from_pretrained(args.hf)
-        cfg = transformer_config_from_hf(
-            hf_model.config, dtype=jnp.float32, max_seq_len=args.prompt_len + args.max_new
-        )
+        cfg = transformer_config_from_hf(hf_model.config, dtype=jnp.float32, max_seq_len=seq_len)
         return DecoderLM(cfg), llama_params_from_hf(hf_model.state_dict(), cfg)
     cfg = TransformerConfig(
         vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
-        hidden_dim=64, mlp_dim=160, max_seq_len=args.prompt_len + args.max_new,
+        hidden_dim=64, mlp_dim=160, max_seq_len=seq_len,
         dtype=jnp.float32,
     )
     model = DecoderLM(cfg)
@@ -53,23 +55,56 @@ def main():
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
     ap.add_argument("--beams", type=int, default=0, help=">0 switches to beam search")
+    ap.add_argument("--int8", action="store_true", help="weight-only int8 quantized decode (models/quant.py)")
+    ap.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="greedy decode via a 1-layer draft proposing K tokens/round (models/speculative.py); "
+        "prints both outputs and checks they match plain greedy",
+    )
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
     model, params = build_model(args)
+    if args.int8:
+        from dmlcloud_tpu.models.quant import quantize_tree, quantized_size
+
+        params = quantize_tree(params)
+        q, full = quantized_size(params)
+        print(f"int8 weights: {q / 1e6:.2f} MB vs {full / 1e6:.2f} MB bf16 "
+              f"({full / q:.2f}x less HBM weight traffic per decoded token)")
     rng = np.random.RandomState(args.seed)
     prompt = jnp.asarray(
         rng.randint(0, model.cfg.vocab_size, (args.batch, args.prompt_len)), jnp.int32
     )
 
     # ragged prompts: row 1 is shorter — LEFT-pad and mask (decode positions
-    # and attention then behave exactly as if it were unpadded)
+    # and attention then behave exactly as if it were unpadded). The
+    # speculative path takes full-width prompts only (no prompt_mask
+    # parameter), so it keeps every row at full length.
     mask = np.ones((args.batch, args.prompt_len), np.int32)
-    if args.batch > 1:
+    if args.batch > 1 and not args.speculative:
         mask[1, : args.prompt_len // 2] = 0
         prompt = prompt.at[1, : args.prompt_len // 2].set(0)
 
-    if args.beams > 0:
+    if args.speculative > 0:
+        from dmlcloud_tpu.models.speculative import speculative_generate
+
+        # a small draft: here random 1-layer (low acceptance — the point of
+        # the demo is the API and the exactness guarantee, not speed)
+        import dataclasses
+
+        dcfg = dataclasses.replace(model.cfg, num_layers=1)
+        draft = DecoderLM(dcfg)
+        dparams = draft.init(jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32))["params"]
+        spec = speculative_generate(
+            model, params, draft, dparams, prompt, args.max_new, k=args.speculative
+        )
+        plain = generate(model, params, prompt, args.max_new)
+        agree = bool((np.asarray(spec) == np.asarray(plain)).all())
+        for row, toks in enumerate(np.asarray(spec)):
+            print(f"row {row} (speculative k={args.speculative}): {toks.tolist()}")
+        print(f"matches plain greedy: {agree}")
+    elif args.beams > 0:
         tokens, scores = beam_search(
             model, params, prompt, args.max_new, num_beams=args.beams,
             prompt_mask=jnp.asarray(mask),
