@@ -368,7 +368,10 @@ class DecoderLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None, attend_len=None):
+    def __call__(
+        self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None, attend_len=None,
+        return_hidden=False,
+    ):
         cfg = self.cfg
         if pad_len is not None and cache is None:
             raise ValueError("pad_len (left-padded ragged prompts) is a decode-mode feature")
@@ -432,6 +435,12 @@ class DecoderLM(nn.Module):
                 )
 
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            # the chunked-vocab loss path (chunked_lm_loss) consumes the
+            # final hidden states directly and never materializes logits
+            if new_cache is not None:
+                raise ValueError("return_hidden is a training-path feature (no cache)")
+            return x
         if cfg.tie_embeddings:
             embed = self.variables["params"]["embed"]["embedding"]
             logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embed.astype(jnp.float32))
@@ -440,6 +449,77 @@ class DecoderLM(nn.Module):
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
             )(x)
         return logits if new_cache is None else (logits, new_cache)
+
+
+def chunked_lm_loss(
+    hidden: jnp.ndarray,
+    kernel: jnp.ndarray,
+    tokens: jnp.ndarray,
+    *,
+    vocab_chunk: int = 8192,
+    segment_ids: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """``lm_loss`` without ever materializing the ``[B, T, vocab]`` logits.
+
+    At vocab 32k+, the logits of a training step can dominate activation
+    memory (8k tokens x 32k vocab x 4B = 1 GB fp32 — more than the rest of
+    a small model's activations combined). This computes the identical
+    next-token cross entropy by streaming the vocab in chunks of
+    ``vocab_chunk``: per chunk, ``hidden @ kernel[:, c]`` feeds an ONLINE
+    logsumexp (the flash-attention trick applied to the loss) and a gather
+    of the target logit; the ``lax.scan`` body is ``jax.checkpoint``-ed so
+    the backward recomputes each chunk's logits instead of storing them.
+    Peak extra memory is O(B*T*vocab_chunk) regardless of vocab size.
+
+    ``hidden`` is the final-norm output (``DecoderLM(..., return_hidden=
+    True)``), ``kernel`` the ``[hidden_dim, vocab]`` projection —
+    ``params["lm_head"]["kernel"]``, or ``embed.T`` for tied embeddings.
+    The kernel is consumed chunk by chunk (full chunks via a scanned
+    dynamic slice, a non-divisible tail as one static epilogue), so no
+    padded or re-typed copy of it is ever built. Matches ``lm_loss(logits,
+    tokens, segment_ids)`` to float32 accuracy (asserted fwd AND grad in
+    tests/test_models.py)."""
+    h = hidden[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    d, v = kernel.shape
+    neg = jnp.float32(-1e30)  # finite sentinel: -inf would NaN the rescale
+
+    def online_update(carry, logits, base):
+        """Fold one chunk's logits [B, T-1, width] starting at vocab index
+        ``base`` into (running max, running sum(exp(logit - m)), target
+        logit)."""
+        m, s, tl = carry
+        new_m = jnp.maximum(m, logits.max(-1))
+        s = s * jnp.exp(m - new_m) + jnp.exp(logits - new_m[..., None]).sum(-1)
+        width = logits.shape[-1]
+        in_chunk = (targets >= base) & (targets < base + width)
+        local = jnp.clip(targets - base, 0, width - 1)
+        picked = jnp.take_along_axis(logits, local[..., None], axis=-1)[..., 0]
+        return new_m, s, jnp.where(in_chunk, picked, tl)
+
+    @jax.checkpoint
+    def body(carry, c):
+        w = jax.lax.dynamic_slice(kernel, (0, c * vocab_chunk), (d, vocab_chunk))
+        # [B, T-1, chunk] — the only logits ever live; the astype fuses
+        # into the matmul's operand read
+        return online_update(carry, h @ w.astype(jnp.float32), c * vocab_chunk), None
+
+    carry = (
+        jnp.full(h.shape[:-1], neg, jnp.float32),
+        jnp.zeros(h.shape[:-1], jnp.float32),
+        jnp.zeros(h.shape[:-1], jnp.float32),
+    )
+    n_full = v // vocab_chunk
+    if n_full:
+        carry, _ = jax.lax.scan(body, carry, jnp.arange(n_full))
+    if v % vocab_chunk:  # static epilogue for the non-divisible tail
+        tail = kernel[:, n_full * vocab_chunk :]
+        carry = jax.checkpoint(
+            lambda c: online_update(c, h @ tail.astype(jnp.float32), n_full * vocab_chunk)
+        )(carry)
+    m, s, tl = carry
+    losses = (m + jnp.log(s)) - tl  # logsumexp - target logit
+    return _packed_mean(losses, segment_ids)
 
 
 def lm_loss(
@@ -455,6 +535,13 @@ def lm_loss(
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return _packed_mean(losses, segment_ids)
+
+
+def _packed_mean(losses: jnp.ndarray, segment_ids: jnp.ndarray | None) -> jnp.ndarray:
+    """Mean of per-position losses; with packed ``segment_ids``, a position
+    only counts when its target is in the SAME non-pad segment. Shared by
+    both loss paths so the packing convention cannot diverge."""
     if segment_ids is None:
         return losses.mean()
     w = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (segment_ids[:, 1:] != 0)

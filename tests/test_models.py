@@ -181,3 +181,65 @@ def test_encoder_remat_matches_no_remat():
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
+
+
+class TestChunkedLMLoss:
+    """chunked_lm_loss must match lm_loss to f32 accuracy, forward AND
+    backward, without materializing [B, T, V] logits."""
+
+    def _setup(self, b=2, t=12, d=16, v=1000):
+        from dmlcloud_tpu.models.transformer import chunked_lm_loss, lm_loss
+
+        rng = np.random.RandomState(0)
+        hidden = jnp.asarray(rng.randn(b, t, d), jnp.float32)
+        kernel = jnp.asarray(rng.randn(d, v) * 0.2, jnp.float32)
+        tokens = jnp.asarray(rng.randint(0, v, (b, t)), jnp.int32)
+        return chunked_lm_loss, lm_loss, hidden, kernel, tokens
+
+    def test_matches_full_loss_nondivisible_chunk(self):
+        chunked, full, hidden, kernel, tokens = self._setup()
+        logits = hidden.astype(jnp.float32) @ kernel
+        want = full(logits, tokens)
+        got = chunked(hidden, kernel, tokens, vocab_chunk=256)  # 1000 % 256 != 0
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_gradients_match(self):
+        chunked, full, hidden, kernel, tokens = self._setup()
+
+        g_full = jax.grad(lambda h, w: full(h.astype(jnp.float32) @ w, tokens), argnums=(0, 1))(
+            hidden, kernel
+        )
+        g_chunk = jax.grad(lambda h, w: chunked(h, w, tokens, vocab_chunk=128), argnums=(0, 1))(
+            hidden, kernel
+        )
+        for a, b in zip(g_full, g_chunk):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_segment_ids_match(self):
+        chunked, full, hidden, kernel, tokens = self._setup()
+        segs = jnp.asarray([[1, 1, 1, 1, 2, 2, 2, 0, 0, 0, 0, 0],
+                            [1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 0, 0]], jnp.int32)
+        logits = hidden.astype(jnp.float32) @ kernel
+        want = full(logits, tokens, segment_ids=segs)
+        got = chunked(hidden, kernel, tokens, vocab_chunk=300, segment_ids=segs)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_through_decoder_lm_return_hidden(self):
+        from dmlcloud_tpu.models.transformer import (
+            DecoderLM,
+            TransformerConfig,
+            chunked_lm_loss,
+            lm_loss,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=260, num_layers=2, num_heads=2, num_kv_heads=1, head_dim=8,
+            hidden_dim=16, mlp_dim=32, max_seq_len=32, dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        tokens = jnp.asarray(np.random.RandomState(1).randint(0, 260, (2, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        want = lm_loss(model.apply({"params": params}, tokens), tokens)
+        hidden = model.apply({"params": params}, tokens, return_hidden=True)
+        got = chunked_lm_loss(hidden, params["lm_head"]["kernel"], tokens, vocab_chunk=64)
+        np.testing.assert_allclose(float(got), float(want), rtol=2e-6)
