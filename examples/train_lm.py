@@ -125,12 +125,23 @@ class LMStage(dml.TrainValStage):
         return 6.0 * n_params * self.config.batch_size * self.config.seq_len
 
     def step(self, state, batch):
+        chunk = int(self.config.get("chunked_loss", 0))
         if self.config.get("pack", False):
             toks, segs = batch[:, 0], batch[:, 1]
-            logits = state.apply_fn({"params": state.params}, toks, segment_ids=segs)
-            return lm_loss(logits, toks, segment_ids=segs)
-        logits = state.apply_fn({"params": state.params}, batch)
-        return lm_loss(logits, batch)
+        else:
+            toks, segs = batch, None
+        if chunk > 0:
+            from dmlcloud_tpu.models.transformer import chunked_lm_loss
+
+            hidden = state.apply_fn(
+                {"params": state.params}, toks, segment_ids=segs, return_hidden=True
+            )
+            return chunked_lm_loss(
+                hidden, state.params["lm_head"]["kernel"], toks,
+                vocab_chunk=chunk, segment_ids=segs,
+            )
+        logits = state.apply_fn({"params": state.params}, toks, segment_ids=segs)
+        return lm_loss(logits, toks, segment_ids=segs)
 
 
 def main():
@@ -151,6 +162,10 @@ def main():
     parser.add_argument("--ema", type=float, default=0.0, help="param EMA decay (0 off); validation uses the average")
     parser.add_argument("--save-every-steps", type=int, default=0, help="mid-epoch step saves (resumable mid-epoch)")
     parser.add_argument("--mfu", action="store_true", help="track misc/mfu from the 6ND estimate")
+    parser.add_argument(
+        "--chunked-loss", type=int, default=0, metavar="CHUNK",
+        help="vocab chunk for chunked_lm_loss (0 = full logits); big-vocab memory lever",
+    )
     parser.add_argument(
         "--sample", type=int, default=0, metavar="N",
         help="after training, greedy-decode N tokens from a corpus prompt (KV-cache generate)",
@@ -176,6 +191,7 @@ def main():
         "ema": args.ema,
         "save_every_steps": args.save_every_steps,
         "mfu": args.mfu,
+        "chunked_loss": args.chunked_loss,
         "seed": 0,
     }
     pipeline = dml.TrainingPipeline(config, name=f"lm-{args.preset}")
