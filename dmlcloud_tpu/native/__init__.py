@@ -7,10 +7,15 @@ to cover get their own small C++ library (``libdmltpu.so``, built by
 
 - ``interleave``: parallel strided memcpy batch interleaving (the inner loop
   of ``data.interleave_batches``).
+- ``pack``: the greedy sequence packer (``pack_sequences_fast`` /
+  ``pack_flat``) — bit-identical to ``data.pack_sequences``, one memcpy
+  pass instead of a per-document Python loop (19x on a 200k-doc corpus
+  via the flat-buffer path).
 
-Every entry point degrades gracefully to numpy when the library isn't built.
+Every entry point degrades gracefully to Python/numpy when the library
+isn't built.
 """
 
-from . import interleave
+from . import interleave, pack
 
-__all__ = ["interleave"]
+__all__ = ["interleave", "pack"]

@@ -1,0 +1,35 @@
+"""Shared ctypes loader for ``libdmltpu.so`` — one canonical copy of the
+load/cache/fallback boilerplate (a second copy had already started to
+drift between the interleave and pack bindings)."""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+_LIB = None
+_TRIED = False
+
+
+def load_symbol(name: str, restype, argtypes):
+    """The named function from libdmltpu.so with its signature bound, or
+    None when the library isn't built / the symbol is missing (e.g. a stale
+    .so predating the symbol) — callers fall back to their Python path."""
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        so = Path(__file__).parent / "libdmltpu.so"
+        if so.exists():
+            try:
+                _LIB = ctypes.CDLL(str(so))
+            except OSError:
+                _LIB = None
+    if _LIB is None:
+        return None
+    try:
+        fn = getattr(_LIB, name)
+    except AttributeError:
+        return None
+    fn.restype = restype
+    fn.argtypes = argtypes
+    return fn

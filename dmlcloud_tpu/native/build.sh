@@ -2,5 +2,5 @@
 # Build libdmltpu.so next to this script. Requires g++ (baked in the image).
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -fPIC -shared -std=c++17 -pthread -o libdmltpu.so interleave.cpp
+g++ -O3 -fPIC -shared -std=c++17 -pthread -o libdmltpu.so interleave.cpp pack.cpp
 echo "built $(pwd)/libdmltpu.so"

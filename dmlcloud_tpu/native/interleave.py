@@ -9,35 +9,24 @@ thread pool — bandwidth-bound instead of interpreter-bound.
 from __future__ import annotations
 
 import ctypes
-from pathlib import Path
 
 import numpy as np
 
-_LIB = None
-_TRIED = False
+from ._lib import load_symbol
 
 
 def _load():
-    global _LIB, _TRIED
-    if _TRIED:
-        return _LIB
-    _TRIED = True
-    so = Path(__file__).parent / "libdmltpu.so"
-    if so.exists():
-        try:
-            lib = ctypes.CDLL(str(so))
-            lib.dmltpu_interleave.restype = ctypes.c_int
-            lib.dmltpu_interleave.argtypes = [
-                ctypes.c_void_p,  # dst
-                ctypes.POINTER(ctypes.c_void_p),  # srcs
-                ctypes.c_long,  # num_batches
-                ctypes.c_long,  # slice_bytes
-                ctypes.c_long,  # batch_bytes
-            ]
-            _LIB = lib
-        except OSError:
-            _LIB = None
-    return _LIB
+    return load_symbol(
+        "dmltpu_interleave",
+        ctypes.c_int,
+        [
+            ctypes.c_void_p,  # dst
+            ctypes.POINTER(ctypes.c_void_p),  # srcs
+            ctypes.c_long,  # num_batches
+            ctypes.c_long,  # slice_bytes
+            ctypes.c_long,  # batch_bytes
+        ],
+    )
 
 
 def available() -> bool:
@@ -53,7 +42,7 @@ def interleave_into(memory: np.ndarray, batches: list[np.ndarray], slice_size: i
     slice_bytes = slice_size * row_bytes
     batch_bytes = batches[0].shape[0] * row_bytes
     srcs = (ctypes.c_void_p * n)(*[b.ctypes.data for b in batches])
-    rc = lib.dmltpu_interleave(
+    rc = lib(
         memory.ctypes.data, srcs, n, slice_bytes, batch_bytes
     )
     if rc != 0:  # pragma: no cover

@@ -1,0 +1,106 @@
+"""ctypes binding for the C++ sequence packer (falls back to the Python
+packer when the library isn't built).
+
+``pack_sequences`` (data/datasets.py) is a per-example Python loop — fine
+for thousands of documents, interpreter-bound for millions. This path
+flattens the corpus once (numpy concatenate) and hands the greedy fill to
+native/pack.cpp, which produces BIT-IDENTICAL rows (asserted in
+tests/test_native.py). Build with ``sh dmlcloud_tpu/native/build.sh``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ._lib import load_symbol
+
+
+def _load():
+    return load_symbol(
+        "dmltpu_pack",
+        ctypes.c_long,
+        [
+            ctypes.c_void_p,  # flat tokens (null when counting)
+            ctypes.c_void_p,  # lengths
+            ctypes.c_long,  # n examples
+            ctypes.c_long,  # seq_len
+            ctypes.c_int,  # split_long
+            ctypes.c_void_p,  # out tokens (null when counting)
+            ctypes.c_void_p,  # out segs (null when counting)
+        ],
+    )
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pack_flat(
+    flat: np.ndarray,
+    lengths: np.ndarray,
+    seq_len: int,
+    *,
+    split_long: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack a pre-flattened corpus: ``flat`` is every example's tokens
+    concatenated, ``lengths`` the per-example counts (what a tokenizer's
+    offsets give directly — no per-example Python objects at all). Returns
+    ``(tokens, segment_ids)`` as ``[rows, seq_len]`` int32 arrays with the
+    exact ``pack_sequences`` semantics. This is the zero-overhead path: the
+    whole corpus is two numpy buffers and one C call each for count + fill.
+
+    Requires the native library (``sh dmlcloud_tpu/native/build.sh``)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native packer not built — run `sh dmlcloud_tpu/native/build.sh` "
+            "(or use data.pack_sequences / pack_sequences_fast, which fall back)"
+        )
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    flat = np.ascontiguousarray(flat, np.int32)
+    lengths = np.ascontiguousarray(lengths, np.int64)
+    if lengths.size and int(lengths.min()) < 0:
+        raise ValueError("lengths must be non-negative")  # a negative entry would OOB-read flat
+    if int(lengths.sum()) != flat.size:
+        raise ValueError(f"lengths sum to {int(lengths.sum())} but flat has {flat.size} tokens")
+    n_rows = lib(
+        None, lengths.ctypes.data, lengths.size, seq_len, int(split_long), None, None
+    )
+    if n_rows < 0:
+        raise ValueError("invalid packing arguments")
+    tokens = np.zeros((n_rows, seq_len), np.int32)
+    segs = np.zeros((n_rows, seq_len), np.int32)
+    filled = lib(
+        flat.ctypes.data, lengths.ctypes.data, lengths.size, seq_len, int(split_long),
+        tokens.ctypes.data, segs.ctypes.data,
+    )
+    assert filled == n_rows, (filled, n_rows)
+    return tokens, segs
+
+
+def pack_sequences_fast(
+    examples: Iterable[Sequence[int] | np.ndarray],
+    seq_len: int,
+    *,
+    split_long: bool = True,
+) -> list[dict]:
+    """Native-path ``pack_sequences``: same inputs, same row dicts
+    (``{"tokens", "segment_ids"}``), bit-identical packing — as a list
+    (the corpus is flattened up front, so there is nothing to stream).
+    Falls back to the Python packer when the library isn't built."""
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    arrays = [np.asarray(ex, np.int32).ravel() for ex in examples]
+    lib = _load()
+    if lib is None:
+        from ..data.datasets import pack_sequences
+
+        return list(pack_sequences(arrays, seq_len, split_long=split_long))
+    lengths = np.fromiter((a.size for a in arrays), np.int64, count=len(arrays))
+    flat = np.concatenate(arrays) if arrays else np.zeros(0, np.int32)
+    tokens, segs = pack_flat(flat, lengths, seq_len, split_long=split_long)
+    return [{"tokens": tokens[i], "segment_ids": segs[i]} for i in range(len(tokens))]

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from dmlcloud_tpu.native import interleave as native
+from dmlcloud_tpu.native import pack as native_pack
 
 
 requires_native = pytest.mark.skipif(not native.available(), reason="libdmltpu.so not built")
@@ -47,3 +48,62 @@ def test_interleave_batches_uses_native_path():
     all_in = np.sort(np.concatenate(batches).ravel())
     all_out = np.sort(np.concatenate(out).ravel())
     np.testing.assert_array_equal(all_in, all_out)
+
+
+class TestNativePacker:
+    """C++ pack.cpp must be bit-identical to data.pack_sequences across
+    split modes, long/empty/exact-fit examples, and the flat-buffer path."""
+
+    pytestmark = pytest.mark.skipif(not native_pack.available(), reason="libdmltpu.so not built")
+
+    def _corpus(self, seed=0, n=400, max_len=40):
+        rng = np.random.RandomState(seed)
+        pieces = [rng.randint(1, 99, size=rng.randint(1, max_len)) for _ in range(n)]
+        pieces += [
+            rng.randint(1, 99, size=130),  # longer than seq_len: split/truncate
+            np.zeros(0, np.int64),  # empty: skipped
+            rng.randint(1, 99, size=64),  # exact row fit
+        ]
+        return pieces
+
+    @pytest.mark.parametrize("split_long", [True, False])
+    def test_bit_identical_to_python(self, split_long):
+        from dmlcloud_tpu.data.datasets import pack_sequences
+        from dmlcloud_tpu.native.pack import pack_sequences_fast
+
+        pieces = self._corpus()
+        want = list(pack_sequences([p.copy() for p in pieces], 64, split_long=split_long))
+        got = pack_sequences_fast([p.copy() for p in pieces], 64, split_long=split_long)
+        assert len(want) == len(got)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a["tokens"], b["tokens"])
+            np.testing.assert_array_equal(a["segment_ids"], b["segment_ids"])
+
+    def test_pack_flat_matches(self):
+        from dmlcloud_tpu.data.datasets import pack_sequences
+        from dmlcloud_tpu.native.pack import pack_flat
+
+        pieces = [np.asarray(p, np.int32) for p in self._corpus(seed=3)]
+        lengths = np.asarray([p.size for p in pieces], np.int64)
+        flat = np.concatenate(pieces)
+        tokens, segs = pack_flat(flat, lengths, 64)
+        want = list(pack_sequences(pieces, 64))
+        np.testing.assert_array_equal(np.stack([r["tokens"] for r in want]), tokens)
+        np.testing.assert_array_equal(np.stack([r["segment_ids"] for r in want]), segs)
+
+    def test_pack_flat_validates_lengths(self):
+        from dmlcloud_tpu.native.pack import pack_flat
+
+        with pytest.raises(ValueError, match="lengths sum"):
+            pack_flat(np.zeros(5, np.int32), np.asarray([3], np.int64), 8)
+
+    def test_empty_corpus(self):
+        from dmlcloud_tpu.native.pack import pack_sequences_fast
+
+        assert pack_sequences_fast([], 16) == []
+
+    def test_pack_flat_rejects_negative_lengths(self):
+        from dmlcloud_tpu.native.pack import pack_flat
+
+        with pytest.raises(ValueError, match="non-negative"):
+            pack_flat(np.zeros(5, np.int32), np.asarray([-3, 8], np.int64), 16)
