@@ -4,6 +4,7 @@ size accounting, and quantized decode through the real generate path."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dmlcloud_tpu.models.quant import (
     QuantizedTensor,
@@ -64,6 +65,7 @@ def _tiny_lm(vocab=64, s=48):
     return model, params
 
 
+@pytest.mark.slow
 def test_quantized_generate_matches_shapes_and_tracks_full():
     from dmlcloud_tpu.models.generate import generate
 

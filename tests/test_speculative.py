@@ -12,6 +12,8 @@ from dmlcloud_tpu.models.generate import generate
 from dmlcloud_tpu.models.speculative import speculative_generate
 from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 
+pytestmark = pytest.mark.slow  # each case compiles a while_loop decode program
+
 
 def _lm(layers, seed, vocab=48, s=96):
     cfg = TransformerConfig(
