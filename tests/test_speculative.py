@@ -80,6 +80,25 @@ def test_eos_early_exit_matches(models):
     np.testing.assert_array_equal(got, want)
 
 
+def test_sliding_window_target_matches(models):
+    """The target's windowed decode mask must hold under the verify pass's
+    multi-token dynamic-offset reads too."""
+    import dataclasses
+
+    _, _, draft, dparams = models
+    cfg = dataclasses.replace(_lm(2, 0)[0].cfg, sliding_window=8)
+    target = DecoderLM(cfg)
+    tparams = target.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray(np.random.RandomState(6).randint(0, 48, (2, 10)), jnp.int32)
+    want = np.asarray(generate(target, tparams, prompt, max_new_tokens=16))
+    got = np.asarray(
+        speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=16, k=3)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_quantized_target_runs(models):
     from dmlcloud_tpu.models.quant import quantize_tree
 
