@@ -37,3 +37,34 @@ def test_step_timer_percentiles():
     assert summary["p95_ms"] >= summary["p50_ms"]
     assert summary["max_ms"] >= summary["p95_ms"]
     assert StepTimer().summary() == {}
+
+
+def test_roofline_requires_trace_dir(tmp_path):
+    import pytest
+
+    from dmlcloud_tpu.utils.profiling import roofline
+
+    with pytest.raises(FileNotFoundError, match="xplane"):
+        roofline(str(tmp_path))
+
+
+def test_format_roofline_renders_without_peaks():
+    from dmlcloud_tpu.utils.profiling import format_roofline
+
+    peaks = {"device": "X", "peak_tflops": 0.0, "peak_hbm_gbps": 0.0}
+    rows = [
+        {"category": "fusion", "time_frac": 0.9, "ms_per_step": 1.0, "tflops": 2.0, "gbps": 10.0, "n_per_step": 3},
+        {"category": "tiny", "time_frac": 0.0001, "ms_per_step": 0.0, "tflops": 0.0, "gbps": 0.0, "n_per_step": 1},
+    ]
+    out = format_roofline(peaks, rows)
+    assert "fusion" in out and "tiny" not in out  # sub-0.1% rows hidden
+    assert "% of peak" not in out  # no bogus percentage from a zero peak
+
+
+def test_peak_flops_for_kind():
+    from dmlcloud_tpu.utils.profiling import chip_peak_flops, peak_flops_for_kind
+
+    assert peak_flops_for_kind("TPU v5 lite") == 197e12
+    assert peak_flops_for_kind("TPU v6e") == 918e12
+    assert peak_flops_for_kind("cpu") is None
+    assert chip_peak_flops() > 0  # falls back on unknown kinds
