@@ -1,0 +1,140 @@
+"""L0 host utilities: git capture, Slurm env parsing (incl. the "4(x2),3"
+tasks-per-node grammar), TCP helpers, thirdparty probing, the enum argparse
+action, and seeding — the small pieces the bootstrap ladder and diagnostics
+are built from (SURVEY.md §2.1 #11-17)."""
+
+import argparse
+import enum
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from dmlcloud_tpu.utils import slurm, tcp, thirdparty
+from dmlcloud_tpu.utils.argparse_ext import EnumAction
+from dmlcloud_tpu.utils.git import git_diff, git_hash
+from dmlcloud_tpu.utils.seed import seed_all, step_key, worker_key
+
+
+@pytest.fixture
+def slurm_env(monkeypatch):
+    def set_env(**kwargs):
+        for k, v in kwargs.items():
+            monkeypatch.setenv(k, str(v))
+
+    # start from a clean slate: the test host may not have any of these
+    for key in list(os.environ):
+        if key.startswith("SLURM"):
+            monkeypatch.delenv(key)
+    return set_env
+
+
+def test_git_hash_in_a_repo(monkeypatch):
+    # under pytest, script-dir resolution points at the pytest binary, so
+    # pin the "user project" to this repo (a real git repo) and check the
+    # whole capture path end to end
+    import dmlcloud_tpu.utils.project as project
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setattr(project, "project_dir", lambda: __import__("pathlib").Path(repo))
+    full, short = git_hash(), git_hash(short=True)
+    assert full and len(full) >= 40
+    assert short and full.startswith(short)
+    assert git_diff() is not None  # may be empty, but the command runs
+
+
+def test_git_hash_none_outside_a_project(monkeypatch):
+    import dmlcloud_tpu.utils.project as project
+
+    monkeypatch.setattr(project, "project_dir", lambda: None)
+    assert git_hash() is None and git_diff() is None
+
+
+def test_slurm_absent(slurm_env):
+    assert not slurm.slurm_available()
+    assert slurm.slurm_job_id() is None
+    assert slurm.slurm_rank() is None
+    assert slurm.slurm_tasks_per_node() is None
+
+
+def test_slurm_basic_env(slurm_env):
+    slurm_env(SLURM_JOB_ID="123", SLURM_PROCID="3", SLURM_NTASKS="8", SLURM_LOCALID="1", SLURM_NODEID="0")
+    assert slurm.slurm_available()
+    assert slurm.slurm_job_id() == "123"
+    assert slurm.slurm_rank() == 3
+    assert slurm.slurm_world_size() == 8
+    assert slurm.slurm_local_rank() == 1
+
+
+@pytest.mark.parametrize(
+    "spec,node,expected",
+    [
+        ("4", 0, 4),
+        ("4(x2),3", 1, 4),  # expanded: [4, 4, 3]
+        ("4(x2),3", 2, 3),
+        ("2,junk,5", 1, 5),  # malformed parts are skipped
+        ("4(x2)", 9, 4),  # node beyond list falls back to first
+    ],
+)
+def test_slurm_tasks_per_node_grammar(slurm_env, spec, node, expected):
+    slurm_env(SLURM_STEP_TASKS_PER_NODE=spec, SLURM_NODEID=node)
+    assert slurm.slurm_tasks_per_node() == expected
+
+
+def test_slurm_head_node_prefers_comm_host(slurm_env):
+    slurm_env(SLURM_SRUN_COMM_HOST="10.0.0.7")
+    assert slurm.slurm_head_node() == "10.0.0.7"
+
+
+def test_find_free_port_binds():
+    port = tcp.find_free_port()
+    assert 0 < port < 65536
+    with socket.socket() as s:  # the port is actually bindable right now
+        s.bind(("127.0.0.1", port))
+
+
+def test_get_local_ips():
+    ips = tcp.get_local_ips()
+    assert isinstance(ips, list) and all(isinstance(ip, str) for ip in ips)
+
+
+def test_thirdparty_probing():
+    assert thirdparty.try_import("numpy") is not None
+    assert thirdparty.try_import("not_a_real_module_xyz") is None
+    assert thirdparty.is_imported("numpy")
+    v = thirdparty.try_get_version("numpy")
+    assert v and v == np.__version__
+    assert thirdparty.try_get_version("not_a_real_module_xyz") is None
+
+
+class Color(enum.Enum):
+    RED = 1
+    GREEN = 2
+
+
+def test_enum_action_maps_lowercase_names():
+    p = argparse.ArgumentParser()
+    p.add_argument("--color", type=Color, action=EnumAction)
+    args = p.parse_args(["--color", "red"])
+    assert args.color is Color.RED
+    with pytest.raises(SystemExit):  # not a member name
+        p.parse_args(["--color", "blue"])
+
+
+def test_enum_action_requires_enum_type():
+    p = argparse.ArgumentParser()
+    with pytest.raises(TypeError, match="Enum"):
+        p.add_argument("--x", action=EnumAction)
+
+
+def test_seed_all_reproducible():
+    k1 = seed_all(7)
+    a = np.random.rand(3)
+    k2 = seed_all(7)
+    b = np.random.rand(3)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+    # derived keys differ from the root and from each other
+    assert not (np.asarray(worker_key(k1, 1)) == np.asarray(k1)).all()
+    assert not (np.asarray(step_key(k1, 3)) == np.asarray(step_key(k1, 4))).all()
