@@ -1,3 +1,6 @@
 """Model zoo: TPU-friendly flax implementations for the BASELINE.json ladder
 (MNIST CNN, ResNet-50, BERT-style encoder, ViT, CLIP dual encoder,
-Llama-style decoder LM with optional MoE)."""
+Llama-style decoder LM with optional MoE), plus the train/deploy toolkit
+around them: ``hf`` (checkpoint import/export), ``generate`` (KV-cache
+sampling + beam search), ``speculative`` (draft-verified greedy),
+``quant`` (weight-only int8 decode), and ``lora`` (adapter finetuning)."""

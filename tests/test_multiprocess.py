@@ -439,3 +439,40 @@ def test_mid_epoch_step_save_and_resume_two_processes(tmp_path):
         assert len(fps) == 1, fps
     finally:
         os.environ.pop("RESUME_PHASE", None)
+
+
+def test_tensorboard_and_wandb_init_are_root_only(tmp_path):
+    """Regression guard for the decorator-placement class of bug: in a
+    2-process run, only the root creates TensorBoard event files (and a
+    stub wandb module records init on the root alone)."""
+    pytest.importorskip("tensorboardX")
+    tb_dir = tmp_path / "tb"
+    body = _TOY_STAGE + """
+    import sys, types, glob
+
+    # stub wandb so _start_wandb's root_only gating is observable without
+    # the real service: record which rank called init
+    calls = []
+    stub = types.ModuleType("wandb")
+    stub.init = lambda **kw: calls.append(RANK)
+    stub.log = lambda *a, **k: None
+    stub.finish = lambda **kw: None
+    stub.run = None
+    sys.modules["wandb"] = stub
+
+    pipeline = dml.TrainingPipeline(name="obs")
+    pipeline.enable_tensorboard({tb!r})
+    pipeline.enable_wandb(project="x")
+    pipeline.append_stage(Toy(), max_epochs=1, name="stage")
+    pipeline.run()
+    assert calls == ([0] if RANK == 0 else []), calls
+    n_events = len(glob.glob({tb!r} + "/events.*"))
+    if RANK == 0:
+        assert n_events >= 1, "root wrote no event files"
+    print("OBS-OK", RANK, n_events)
+    """.format(tb=str(tb_dir))
+    outs = _spawn(tmp_path, body, timeout=480)
+    assert all("OBS-OK" in out for out in outs)
+    import glob
+
+    assert len(glob.glob(str(tb_dir) + "/events.*")) == 1  # exactly one writer existed
