@@ -2,5 +2,5 @@
 (MNIST CNN, ResNet-50, BERT-style encoder, ViT, CLIP dual encoder,
 Llama-style decoder LM with optional MoE), plus the train/deploy toolkit
 around them: ``hf`` (checkpoint import/export), ``generate`` (KV-cache
-sampling + beam search), ``speculative`` (draft-verified greedy),
+sampling + beam search), ``speculative`` (draft-verified greedy/sampled decode),
 ``quant`` (weight-only int8 decode), and ``lora`` (adapter finetuning)."""

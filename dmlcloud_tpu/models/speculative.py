@@ -1,14 +1,19 @@
-"""Speculative decoding: exact greedy generation, fewer target passes.
+"""Speculative decoding: exact greedy OR exact sampled generation, fewer
+target passes.
 
 A small draft model proposes ``k`` tokens autoregressively; the target
-model verifies all of them in ONE forward pass (k+1 positions) and accepts
-the longest matching prefix plus its own correction token. Greedy-only, so
-the output matches ``generate(target, ...)`` at ``temperature=0`` token
-for token (asserted in tests) — the draft changes the cost, never the
-result. One caveat: the verify pass batches k+1 positions where plain
-decode runs one, so a bf16 near-tie between two logits can reduce in a
-different order and flip an argmax; exact-arithmetic (fp32) configs are
-bitwise-identical. Decode cost per accepted token drops from one full
+model verifies all of them in ONE forward pass (k+1 positions). At
+temperature 0 it accepts the longest matching prefix plus its own
+correction token; at temperature > 0 it runs the rejection-sampling
+acceptance rule (accept with ``min(1, p_t/p_d)``, resample rejections
+from the residual), which preserves the target's sampling distribution
+exactly. Either way the draft changes the cost, never the result: greedy
+output matches ``generate(target, ...)`` token for token, sampled output
+is statistically indistinguishable from target-only sampling (both
+asserted in tests). One caveat: the verify pass batches k+1 positions
+where plain decode runs one, so a bf16 near-tie between two logits can
+reduce in a different order and flip an argmax; exact-arithmetic (fp32)
+configs are bitwise-identical. Decode cost per accepted token drops from one full
 weight-stream of the target to ``~1/(n_accept+1)`` of one, plus k+1 cheap
 draft passes; with a well-matched draft this is a 2-3x wall-clock win on
 the weight-bandwidth-bound decode path. (The reference has no inference
@@ -56,10 +61,13 @@ def _row_spec_decode(
     target_params,
     draft_params,
     prompt,  # [T] int32, one row
+    rng,  # per-row PRNG key (unused at temperature 0)
     max_new_tokens: int,
     k: int,
     eos_id: int,
     pad_id: int,
+    temperature,  # traced scalar — a new value must not recompile
+    sampled: bool,  # static: selects the greedy or rejection-sampling body
 ):
     from .generate import init_cache
     from .quant import dequant_tree
@@ -83,16 +91,25 @@ def _row_spec_decode(
     )
     _, dcache = draft.apply({"params": draft_params}, row, cache=dcache, offset=0, attend_len=t)
 
+    def _pick(logits, key):
+        """Next token from target logits: argmax, or a temperature sample."""
+        if not sampled:
+            return _greedy(logits)
+        return jax.random.categorical(key, logits.astype(jnp.float32) / temperature)
+
     # y holds the full sequence: prompt + generated (+ slack)
     y = jnp.zeros((cache_len,), jnp.int32)
     y = jax.lax.dynamic_update_slice(y, prompt, (0,))
-    first_tok = _greedy(tlogits[0, -1])  # target's token for position t
+    rng, first_key = jax.random.split(rng)
+    # the first new token needs no speculation: it comes straight from the
+    # target's prefill logits (exact greedy / exact target sample)
+    first_tok = _pick(tlogits[0, -1], first_key).astype(jnp.int32)
     y = y.at[t].set(first_tok)
-    # pos = next position to fill; the first target token is already exact
-    # (it needed no speculation), so rounds start at pos = t+1
+    # pos = next position to fill; rounds start at pos = t+1
     state = {
         "pos": jnp.asarray(t + 1, jnp.int32),
         "y": y,
+        "rng": rng,
         "tcache": tcache,
         "dcache": dcache,
         "done": first_tok == eos_id,
@@ -103,6 +120,7 @@ def _row_spec_decode(
 
     def round_body(s):
         pos = s["pos"]
+        round_key = jax.random.fold_in(s["rng"], pos) if sampled else None
 
         # --- draft proposes k tokens (k+1 passes: the last one exists only
         # to write d_k's K/V so the draft cache has no gap after a full
@@ -116,10 +134,16 @@ def _row_spec_decode(
                 offset=pos - 1 + i,
                 attend_len=cache_len,
             )
-            nxt = _greedy(logits[0, 0])
-            return (dcache, nxt), nxt
+            row = logits[0, 0]
+            if sampled:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(round_key, i), row.astype(jnp.float32) / temperature
+                ).astype(jnp.int32)
+            else:
+                nxt = _greedy(row)
+            return (dcache, nxt), (nxt, row)
 
-        (dcache, _), proposals = jax.lax.scan(
+        (dcache, _), (proposals, dlogits) = jax.lax.scan(
             draft_step, (s["dcache"], s["y"][pos - 1]), jnp.arange(k + 1)
         )
         proposals = proposals[:k]  # [k] — the (k+1)-th output is discarded
@@ -133,14 +157,46 @@ def _row_spec_decode(
             offset=pos - 1,
             attend_len=cache_len,
         )
-        greedy = _greedy(tlogits[0])  # [k+1]: target tokens for pos..pos+k
 
-        # longest matching prefix, then the target's correction token.
-        # Wherever a proposal matched, proposal == greedy, so greedy[i] IS
-        # the accepted token for every i <= n_accept (correction included).
-        match = proposals == greedy[:k]
-        n_accept = jnp.argmin(jnp.concatenate([match, jnp.asarray([False])]))  # first miss
-        new_tokens = jnp.where(jnp.arange(k + 1) <= n_accept, greedy, pad_id)
+        if not sampled:
+            greedy = _greedy(tlogits[0])  # [k+1]: target tokens for pos..pos+k
+            # longest matching prefix, then the target's correction token.
+            # Wherever a proposal matched, proposal == greedy, so greedy[i]
+            # IS the accepted token for every i <= n_accept (correction
+            # included).
+            match = proposals == greedy[:k]
+            n_accept = jnp.argmin(jnp.concatenate([match, jnp.asarray([False])]))  # first miss
+            new_tokens = jnp.where(jnp.arange(k + 1) <= n_accept, greedy, pad_id)
+        else:
+            # Rejection sampling (Leviathan et al. 2023): accept proposal
+            # d_i with prob min(1, p_t(d_i)/p_d(d_i)); at the first
+            # rejection, resample from the residual max(p_t - p_d, 0); if
+            # all k accepted, sample the bonus token from the target's
+            # (k+1)-th distribution. Preserves the target sampling
+            # distribution EXACTLY (asserted statistically in tests).
+            tlp = jax.nn.log_softmax(tlogits[0].astype(jnp.float32) / temperature)  # [k+1, V]
+            dlp = jax.nn.log_softmax(dlogits.astype(jnp.float32) / temperature)  # [k+1, V]
+            idx = jnp.arange(k)
+            lp_t = tlp[idx, proposals]  # log p_t(d_i) at each proposal
+            lp_d = dlp[idx, proposals]
+            u = jax.random.uniform(jax.random.fold_in(round_key, k + 1), (k,))
+            accept = jnp.log(u) < jnp.minimum(lp_t - lp_d, 0.0)
+            n_accept = jnp.argmin(jnp.concatenate([accept, jnp.asarray([False])]))
+            # the position-n_accept token: residual resample on rejection,
+            # plain target sample when every proposal was accepted (the
+            # dlp row there is the discarded (k+1)-th draft pass — unused)
+            p_t = jnp.exp(tlp[n_accept])
+            residual = jnp.maximum(p_t - jnp.exp(dlp[n_accept]), 0.0)
+            probs = jnp.where(n_accept == k, p_t, residual)
+            probs = probs / jnp.maximum(probs.sum(), 1e-30)
+            final_tok = jax.random.categorical(
+                jax.random.fold_in(round_key, k + 2), jnp.log(probs + 1e-30)
+            ).astype(jnp.int32)
+            prop_pad = jnp.concatenate([proposals, jnp.asarray([pad_id], jnp.int32)])
+            ar = jnp.arange(k + 1)
+            new_tokens = jnp.where(
+                ar < n_accept, prop_pad, jnp.where(ar == n_accept, final_tok, pad_id)
+            )
         # tokens past the first eos inside the round must not count
         is_eos = new_tokens == eos_id
         seen_eos = jnp.cumsum(is_eos) - is_eos.astype(jnp.int32) > 0  # strictly after an eos
@@ -157,6 +213,7 @@ def _row_spec_decode(
         new_state = {
             "pos": jnp.where(done_row, pos, pos + n_new),
             "y": jnp.where(done_row, s["y"], y_new),
+            "rng": s["rng"],
             "tcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["tcache"], tcache),
             "dcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["dcache"], dcache),
             "done": done_row | hit_eos,
@@ -172,14 +229,20 @@ def _row_spec_decode(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("target", "draft", "max_new_tokens", "k", "eos_id", "pad_id")
+    jax.jit,
+    static_argnames=("target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled"),
 )
-def _spec_compiled(target, draft, target_params, draft_params, prompt, max_new_tokens, k, eos_id, pad_id):
+def _spec_compiled(
+    target, draft, target_params, draft_params, prompt, rng, temperature, max_new_tokens, k,
+    eos_id, pad_id, sampled,
+):
     row_fn = functools.partial(
         _row_spec_decode, target, draft,
         max_new_tokens=max_new_tokens, k=k, eos_id=eos_id, pad_id=pad_id,
+        temperature=temperature, sampled=sampled,
     )
-    return jax.vmap(lambda p: row_fn(target_params, draft_params, p))(prompt)
+    row_keys = jax.random.split(rng, prompt.shape[0])
+    return jax.vmap(lambda p, key: row_fn(target_params, draft_params, p, key))(prompt, row_keys)
 
 
 def speculative_generate(
@@ -191,26 +254,44 @@ def speculative_generate(
     max_new_tokens: int = 32,
     *,
     k: int = 4,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
     eos_id: int = -1,
     pad_id: int = 0,
 ):
-    """Greedy-decode ``max_new_tokens`` continuations of ``prompt`` [B, T]
-    using ``draft`` to propose ``k`` tokens per target verification pass.
-    Output is identical to ``generate(target, target_params, prompt, ...)``
-    at temperature 0 — speculation changes cost, not results. Both models
-    must share the tokenizer/vocab; either params tree may be int8
-    weight-only quantized (models/quant.py)."""
+    """Decode ``max_new_tokens`` continuations of ``prompt`` [B, T] using
+    ``draft`` to propose ``k`` tokens per target verification pass: at
+    ``temperature == 0`` (default) the output is token-identical to greedy
+    ``generate(target, ...)``; at ``temperature > 0`` it is speculative
+    SAMPLING via rejection (Leviathan et al. 2023) — accept each proposal
+    with probability ``min(1, p_target/p_draft)``, resample rejections
+    from the residual — distributed exactly as target-only sampling at
+    that temperature (``rng`` seeds it). Speculation changes cost, never
+    results.
+
+    Both models must share the tokenizer/vocab; either params tree may be
+    int8 weight-only quantized (models/quant.py). The temperature value is
+    traced (sweeping it does not recompile); only the greedy-vs-sampled
+    switch is compiled in."""
     prompt = jnp.asarray(prompt, jnp.int32)
     _, t = prompt.shape
     if k < 1:
         raise ValueError(f"k (draft proposals per round) must be >= 1, got {k}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     for m, name in ((target, "target"), (draft, "draft")):
         if t + max_new_tokens + k + 1 > m.cfg.max_seq_len:
             raise ValueError(
                 f"prompt ({t}) + max_new_tokens ({max_new_tokens}) + k+1 ({k + 1}) exceeds the "
                 f"{name} model's max_seq_len ({m.cfg.max_seq_len})"
             )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    # greedy-vs-sampled is the only static switch; the temperature VALUE is
+    # a traced operand so sweeping it never recompiles (generate()'s
+    # convention). max(t, 1) keeps the unused division safe at t == 0.
     return _spec_compiled(
-        target, draft, target_params, draft_params, prompt,
-        int(max_new_tokens), int(k), int(eos_id), int(pad_id),
+        target, draft, target_params, draft_params, prompt, rng,
+        jnp.float32(max(float(temperature), 1e-6)),
+        int(max_new_tokens), int(k), int(eos_id), int(pad_id), float(temperature) > 0.0,
     )

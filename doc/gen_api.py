@@ -45,7 +45,7 @@ MODULES = [
     ("dmlcloud_tpu.models.hf", "HuggingFace checkpoint import."),
     ("dmlcloud_tpu.models.lora", "LoRA adapter finetuning (init/merge/export)."),
     ("dmlcloud_tpu.models.quant", "Weight-only int8 quantization for decode."),
-    ("dmlcloud_tpu.models.speculative", "Speculative decoding (exact greedy, draft-verified)."),
+    ("dmlcloud_tpu.models.speculative", "Speculative decoding: exact greedy or exact sampled, draft-verified."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),
     ("dmlcloud_tpu.data.device", "Host-to-device batch transfer."),

@@ -58,8 +58,9 @@ def main():
     ap.add_argument("--int8", action="store_true", help="weight-only int8 quantized decode (models/quant.py)")
     ap.add_argument(
         "--speculative", type=int, default=0, metavar="K",
-        help="greedy decode via a 1-layer draft proposing K tokens/round (models/speculative.py); "
-        "prints both outputs and checks they match plain greedy",
+        help="decode via a 1-layer draft proposing K tokens/round (models/speculative.py; "
+        "greedy here — sampled mode takes temperature/rng); prints both outputs and checks "
+        "they match plain greedy",
     )
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
