@@ -62,12 +62,14 @@ def _row_spec_decode(
     draft_params,
     prompt,  # [T] int32, one row
     rng,  # per-row PRNG key (unused at temperature 0)
+    pad_len,  # [1] int32 — this row's LEFT-pad count (0 when not ragged)
     max_new_tokens: int,
     k: int,
     eos_id: int,
     pad_id: int,
     temperature,  # traced scalar — a new value must not recompile
     sampled: bool,  # static: selects the greedy or rejection-sampling body
+    ragged: bool,  # static: False keeps the pad_len=None fast path compiled
 ):
     from .generate import init_cache
     from .quant import dequant_tree
@@ -76,6 +78,9 @@ def _row_spec_decode(
     draft_params = dequant_tree(draft_params, draft.cfg.dtype)
 
     t = prompt.shape[0]
+    # vmap hands a scalar; apply wants [B]=[1]. Unpadded calls pass None so
+    # the transformer keeps its cheaper non-ragged decode program
+    pad_len = jnp.reshape(pad_len, (1,)) if ragged else None
     # slack: the last round may propose past the buffer end; clamp-free
     # writes land in the slack and are sliced off at the end
     cache_len = t + max_new_tokens + k + 1
@@ -87,9 +92,11 @@ def _row_spec_decode(
     # one-time full passes, the fill-proportional chunking that matters in
     # plain decode buys little across a single prefill.
     tlogits, tcache = target.apply(
-        {"params": target_params}, row, cache=tcache, offset=0, attend_len=t
+        {"params": target_params}, row, cache=tcache, offset=0, pad_len=pad_len, attend_len=t
     )
-    _, dcache = draft.apply({"params": draft_params}, row, cache=dcache, offset=0, attend_len=t)
+    _, dcache = draft.apply(
+        {"params": draft_params}, row, cache=dcache, offset=0, pad_len=pad_len, attend_len=t
+    )
 
     def _pick(logits, key):
         """Next token from target logits: argmax, or a temperature sample."""
@@ -132,6 +139,7 @@ def _row_spec_decode(
                 prev[None, None],
                 cache=dcache,
                 offset=pos - 1 + i,
+                pad_len=pad_len,
                 attend_len=cache_len,
             )
             row = logits[0, 0]
@@ -155,6 +163,7 @@ def _row_spec_decode(
             x,
             cache=s["tcache"],
             offset=pos - 1,
+            pad_len=pad_len,
             attend_len=cache_len,
         )
 
@@ -230,19 +239,21 @@ def _row_spec_decode(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled"),
+    static_argnames=("target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled", "ragged"),
 )
 def _spec_compiled(
-    target, draft, target_params, draft_params, prompt, rng, temperature, max_new_tokens, k,
-    eos_id, pad_id, sampled,
+    target, draft, target_params, draft_params, prompt, rng, pad_len, temperature,
+    max_new_tokens, k, eos_id, pad_id, sampled, ragged,
 ):
     row_fn = functools.partial(
         _row_spec_decode, target, draft,
         max_new_tokens=max_new_tokens, k=k, eos_id=eos_id, pad_id=pad_id,
-        temperature=temperature, sampled=sampled,
+        temperature=temperature, sampled=sampled, ragged=ragged,
     )
     row_keys = jax.random.split(rng, prompt.shape[0])
-    return jax.vmap(lambda p, key: row_fn(target_params, draft_params, p, key))(prompt, row_keys)
+    return jax.vmap(
+        lambda p, key, pl: row_fn(target_params, draft_params, p, key, pl)
+    )(prompt, row_keys, pad_len)
 
 
 def speculative_generate(
@@ -256,6 +267,7 @@ def speculative_generate(
     k: int = 4,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    prompt_mask: jnp.ndarray | None = None,
     eos_id: int = -1,
     pad_id: int = 0,
 ):
@@ -270,9 +282,10 @@ def speculative_generate(
     results.
 
     Both models must share the tokenizer/vocab; either params tree may be
-    int8 weight-only quantized (models/quant.py). The temperature value is
-    traced (sweeping it does not recompile); only the greedy-vs-sampled
-    switch is compiled in."""
+    int8 weight-only quantized (models/quant.py). Ragged prompts work like
+    ``generate``: LEFT-pad and pass ``prompt_mask`` ([B, T] {0,1}, zeros
+    first). The temperature value is traced (sweeping it does not
+    recompile); only the greedy-vs-sampled switch is compiled in."""
     prompt = jnp.asarray(prompt, jnp.int32)
     _, t = prompt.shape
     if k < 1:
@@ -287,11 +300,17 @@ def speculative_generate(
             )
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    from .generate import _pad_len_from_mask
+
+    pad_len = _pad_len_from_mask(prompt_mask, prompt.shape[0], t)
+    ragged = pad_len is not None
+    if not ragged:  # dummy zeros ride the vmap; the static flag drops them
+        pad_len = jnp.zeros((prompt.shape[0],), jnp.int32)
     # greedy-vs-sampled is the only static switch; the temperature VALUE is
     # a traced operand so sweeping it never recompiles (generate()'s
     # convention). max(t, 1) keeps the unused division safe at t == 0.
     return _spec_compiled(
-        target, draft, target_params, draft_params, prompt, rng,
+        target, draft, target_params, draft_params, prompt, rng, pad_len,
         jnp.float32(max(float(temperature), 1e-6)),
-        int(max_new_tokens), int(k), int(eos_id), int(pad_id), float(temperature) > 0.0,
+        int(max_new_tokens), int(k), int(eos_id), int(pad_id), float(temperature) > 0.0, ragged,
     )
