@@ -79,11 +79,10 @@ def main():
     )
 
     # ragged prompts: row 1 is shorter — LEFT-pad and mask (decode positions
-    # and attention then behave exactly as if it were unpadded). The
-    # speculative path takes full-width prompts only (no prompt_mask
-    # parameter), so it keeps every row at full length.
+    # and attention then behave exactly as if it were unpadded; all three
+    # decode paths — greedy/sampled, beam, speculative — take the mask)
     mask = np.ones((args.batch, args.prompt_len), np.int32)
-    if args.batch > 1 and not args.speculative:
+    if args.batch > 1:
         mask[1, : args.prompt_len // 2] = 0
         prompt = prompt.at[1, : args.prompt_len // 2].set(0)
 
@@ -100,12 +99,13 @@ def main():
         spec = speculative_generate(
             model, params, draft, dparams, prompt, args.max_new, k=args.speculative,
             temperature=args.temperature, rng=jax.random.PRNGKey(args.seed),
+            prompt_mask=jnp.asarray(mask),
         )
         mode = "greedy" if args.temperature == 0 else f"sampled T={args.temperature}"
         for row, toks in enumerate(np.asarray(spec)):
             print(f"row {row} (speculative k={args.speculative}, {mode}): {toks.tolist()}")
         if args.temperature == 0:  # sampled mode matches in DISTRIBUTION, not per token
-            plain = generate(model, params, prompt, args.max_new)
+            plain = generate(model, params, prompt, args.max_new, prompt_mask=jnp.asarray(mask))
             print(f"matches plain greedy: {bool((np.asarray(spec) == np.asarray(plain)).all())}")
     elif args.beams > 0:
         tokens, scores = beam_search(
