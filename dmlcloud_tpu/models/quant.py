@@ -14,8 +14,9 @@ Design:
   ``beam_search`` entry points unchanged — they dequantize INSIDE the
   compiled program, which keeps the HBM-resident buffers int8 and lets
   XLA fuse the dequant (convert + multiply) into each consumer.
-- Symmetric per-channel quantization along the kernel's LAST axis (the
-  output features): ``w ~= q * scale``, scale = max|w| / 127 per channel.
+- Symmetric per-channel quantization: ``w ~= q * scale`` with the amax
+  reduced over the kernel's leading input axes, so every trailing output
+  coordinate keeps its own scale (see :func:`quantize`).
 - Weight-only: activations stay in the model's compute dtype. This is the
   bandwidth-bound inference tradeoff — training and prefill (compute-
   bound) keep full precision.
@@ -53,12 +54,20 @@ class QuantizedTensor(struct.PyTreeNode):
         return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
 
 
-def quantize(w: jax.Array, axis: int = -1) -> QuantizedTensor:
-    """Symmetric per-channel int8 quantization of ``w`` along ``axis``
-    (default: last axis = output features; each output channel gets its own
-    scale, which is what keeps matmul outputs accurate)."""
+def quantize(w: jax.Array, *, num_input_axes: int = 1) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 quantization of ``w``.
+
+    The amax is reduced over the leading ``num_input_axes`` axes (the dims a
+    matmul collapses), so every trailing output coordinate keeps its own
+    scale. For 2D ``[in, out]`` kernels that is the classic per-output-column
+    scale; for DenseGeneral-style ``[in, heads, head_dim]`` kernels each
+    (head, head_dim) output channel gets its own scale rather than sharing
+    one across heads. Finer-than-per-channel scales (e.g. an out-projection
+    ``[heads, head_dim, out]`` with the default ``num_input_axes=1``) are
+    still exact elementwise and only cost a slightly larger scale tensor.
+    """
     w = jnp.asarray(w)
-    reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    reduce_axes = tuple(range(min(num_input_axes, w.ndim - 1)))
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)

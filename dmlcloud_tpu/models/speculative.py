@@ -308,7 +308,7 @@ def speculative_generate(
         pad_len = jnp.zeros((prompt.shape[0],), jnp.int32)
     # greedy-vs-sampled is the only static switch; the temperature VALUE is
     # a traced operand so sweeping it never recompiles (generate()'s
-    # convention). max(t, 1) keeps the unused division safe at t == 0.
+    # convention). The 1e-6 clamp keeps the unused division safe at t == 0.
     return _spec_compiled(
         target, draft, target_params, draft_params, prompt, rng, pad_len,
         jnp.float32(max(float(temperature), 1e-6)),

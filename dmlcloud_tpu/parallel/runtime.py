@@ -436,64 +436,128 @@ def _kv_key(name: str, seq: int, src: int) -> str:
     return f"dmlcloud_tpu/obj/{name}/{seq}/{src}"
 
 
-def _put_obj(key: str, obj: Any) -> None:
-    payload = base64.b64encode(pickle.dumps(obj)).decode("ascii")
+class CollectiveMismatchError(RuntimeError):
+    """Two processes paired up collectives issued from DIFFERENT call sites.
+
+    The object collectives match messages by a per-process sequence counter,
+    which assumes every process issues the identical sequence of collective
+    calls. A rank-conditional extra (or skipped) call would silently pair
+    call N on one rank with a different call N on another and deliver the
+    wrong object; the call-site tag carried inside every payload turns that
+    into this loud error whenever the misaligned pair spans two different
+    call sites. (A misalignment that realigns the SAME line with itself —
+    e.g. one rank running an extra loop iteration of one collective — pairs
+    identical tags and is not detectable from the tag alone.)"""
+
+    def __init__(self, kind: str, seq: int, local_tag: str, remote_tag: str, src: int):
+        self.local_tag, self.remote_tag = local_tag, remote_tag
+        super().__init__(
+            f"control-plane {kind} #{seq}: this process called from {local_tag} but "
+            f"rank {src} published from {remote_tag} — the ranks' collective call "
+            "sequences have diverged (a rank-conditional collective call?). If the "
+            "differing call sites are intentional, pass the same explicit tag= on "
+            "both sides."
+        )
+
+
+def _call_site_tag() -> str:
+    """``file.py:lineno`` of the first frame outside this module — the user
+    call site, fingerprinting WHICH collective call this is."""
+    import sys
+
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:  # pragma: no cover - interpreter entry
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _put_obj(key: str, obj: Any, tag: str) -> None:
+    payload = base64.b64encode(pickle.dumps((tag, obj))).decode("ascii")
     _client().key_value_set(key, payload)
 
 
-def _get_obj(key: str, timeout: float) -> Any:
+def _get_obj(key: str, timeout: float, *, expect_tag: str, kind: str, seq: int, src: int) -> Any:
     payload = _client().blocking_key_value_get(key, int(timeout * 1000))
-    return pickle.loads(base64.b64decode(payload))
+    remote_tag, obj = pickle.loads(base64.b64decode(payload))
+    if remote_tag != expect_tag:
+        raise CollectiveMismatchError(kind, seq, expect_tag, remote_tag, src)
+    return obj
 
 
-def broadcast_object(obj: Any = None, root: int = 0, timeout: float = _DEFAULT_TIMEOUT) -> Any:
+def broadcast_object(
+    obj: Any = None, root: int = 0, timeout: float = _DEFAULT_TIMEOUT, tag: str | None = None
+) -> Any:
     """Broadcast a picklable object from ``root`` to all processes
     (reference ``broadcast_object``, util/distributed.py:136-139). Rides the
-    coordination-service KV store — small payloads, no device memory."""
+    coordination-service KV store — small payloads, no device memory.
+
+    Every payload carries a call-site tag (default: the caller's file:line)
+    that receivers verify, so rank-divergent call sequences fail with
+    :class:`CollectiveMismatchError` instead of silently delivering the wrong
+    object. Pass an explicit shared ``tag`` when matching calls legitimately
+    come from different lines (e.g. an if/else on ``is_root()``)."""
     if world_size() <= 1:
         return obj
+    tag = tag or _call_site_tag()
     _seq["obj"] += 1
-    key = _kv_key("bcast", _seq["obj"], root)
+    seq = _seq["obj"]
+    key = _kv_key("bcast", seq, root)
     if rank() == root:
-        _put_obj(key, obj)
+        _put_obj(key, obj, tag)
         return obj
-    return _get_obj(key, timeout)
+    return _get_obj(key, timeout, expect_tag=tag, kind="broadcast_object", seq=seq, src=root)
 
 
-def _get_objs(name: str, seq: int, timeout: float) -> list[Any]:
+def _get_objs(name: str, seq: int, timeout: float, expect_tag: str) -> list[Any]:
     """Fetch every rank's KV entry CONCURRENTLY — ``blocking_key_value_get``
     releases the GIL during its gRPC wait, so a thread pool turns O(world)
     serial round trips into ~one."""
     from concurrent.futures import ThreadPoolExecutor
 
     n = world_size()
+
+    def fetch(src: int) -> Any:
+        return _get_obj(
+            _kv_key(name, seq, src), timeout, expect_tag=expect_tag, kind=name, seq=seq, src=src
+        )
+
     with ThreadPoolExecutor(max_workers=min(n, 32)) as ex:
-        return list(ex.map(lambda src: _get_obj(_kv_key(name, seq, src), timeout), range(n)))
+        return list(ex.map(fetch, range(n)))
 
 
-def all_gather_object(obj: Any, timeout: float = _DEFAULT_TIMEOUT) -> list[Any]:
+def all_gather_object(
+    obj: Any, timeout: float = _DEFAULT_TIMEOUT, tag: str | None = None
+) -> list[Any]:
     """Gather one picklable object from every process, returned to all ranks
-    ordered by rank (reference ``all_gather_object``, util/distributed.py:121-128)."""
+    ordered by rank (reference ``all_gather_object``, util/distributed.py:121-128).
+    Call-site-tag verified — see :func:`broadcast_object`."""
     if world_size() <= 1:
         return [obj]
+    tag = tag or _call_site_tag()
     _seq["obj"] += 1
     seq = _seq["obj"]
-    _put_obj(_kv_key("agather", seq, rank()), obj)
-    return _get_objs("agather", seq, timeout)
+    _put_obj(_kv_key("agather", seq, rank()), obj, tag)
+    return _get_objs("agather", seq, timeout, tag)
 
 
-def gather_object(obj: Any, root: int = 0, timeout: float = _DEFAULT_TIMEOUT) -> list[Any] | None:
+def gather_object(
+    obj: Any, root: int = 0, timeout: float = _DEFAULT_TIMEOUT, tag: str | None = None
+) -> list[Any] | None:
     """Gather objects to ``root`` only; other ranks get None (reference
-    ``gather_object``, util/distributed.py:131-133)."""
+    ``gather_object``, util/distributed.py:131-133).
+    Call-site-tag verified — see :func:`broadcast_object`."""
     if world_size() <= 1:
         return [obj]
+    tag = tag or _call_site_tag()
     _seq["obj"] += 1
     seq = _seq["obj"]
-    _put_obj(_kv_key("gather", seq, rank()), obj)
+    _put_obj(_kv_key("gather", seq, rank()), obj, tag)
     barrier("gather_object", timeout)
     if rank() != root:
         return None
-    return _get_objs("gather", seq, timeout)
+    return _get_objs("gather", seq, timeout, tag)
 
 
 def all_gather_array(x) -> np.ndarray:

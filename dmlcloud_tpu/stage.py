@@ -357,7 +357,10 @@ class TrainValStage(Stage):
         the convention hardware peaks use). Return a positive number and the
         stage tracks ``misc/mfu`` each epoch from the measured per-step
         wall clock and the mesh's aggregate chip peak
-        (``utils.profiling.chip_peak_flops``). 0 (default) disables.
+        (``utils.profiling.peak_flops_for_kind``). 0 (default) disables; on
+        backends whose device kind has no entry in the bf16 peak table
+        (CPU/GPU dev runs) the metric is skipped rather than computed
+        against a made-up peak.
 
         Rules of thumb: transformer training ≈ ``6 * params * tokens_per_
         batch`` (PaLM convention, embedding lookups excluded); ResNet-50 @
@@ -1060,17 +1063,19 @@ class TrainValStage(Stage):
                 kind = jax.local_devices()[0].device_kind
                 peak = peak_flops_for_kind(kind)
                 if peak is None:
-                    peak = 197e12
+                    # no honest denominator for this backend (CPU/GPU dev
+                    # runs): skip the metric rather than log a fiction
                     if not getattr(self, "_warned_mfu_peak", False):
                         self._warned_mfu_peak = True
                         self.logger.warning(
                             f"device kind {kind!r} is not in the bf16 peak table; "
-                            "misc/mfu uses the TPU v5e peak (197 TF/s) as a stand-in"
+                            "misc/mfu will not be tracked on this backend"
                         )
-                peak_total = peak * int(self.mesh.devices.size)
-                self.track(
-                    "misc/mfu", flops * steps_done / train_elapsed / peak_total, prefixed=False
-                )
+                else:
+                    peak_total = peak * int(self.mesh.devices.size)
+                    self.track(
+                        "misc/mfu", flops * steps_done / train_elapsed / peak_total, prefixed=False
+                    )
         self.table["it/s"] = steps_done / max(train_elapsed, 1e-9)
 
         for name, schedule in self.pipeline.schedulers.items():

@@ -49,6 +49,7 @@ class LMStage(dml.TrainValStage):
             vocab_size=cfg.vocab_size,
             max_seq_len=cfg.seq_len,
             attn_impl=cfg.attn,
+            tie_embeddings=bool(cfg.get("tie_embeddings", False)),
             remat=bool(cfg.get("remat", False)),
             sliding_window=cfg.get("window"),
             # ring attention under plain jit needs the mesh to shard_map
@@ -136,9 +137,12 @@ class LMStage(dml.TrainValStage):
             hidden = state.apply_fn(
                 {"params": state.params}, toks, segment_ids=segs, return_hidden=True
             )
+            if self.model.cfg.tie_embeddings:
+                head = state.params["embed"]["embedding"].T
+            else:
+                head = state.params["lm_head"]["kernel"]
             return chunked_lm_loss(
-                hidden, state.params["lm_head"]["kernel"], toks,
-                vocab_chunk=chunk, segment_ids=segs,
+                hidden, head, toks, vocab_chunk=chunk, segment_ids=segs,
             )
         logits = state.apply_fn({"params": state.params}, toks, segment_ids=segs)
         return lm_loss(logits, toks, segment_ids=segs)
@@ -157,6 +161,7 @@ def main():
     parser.add_argument("--window", type=int, default=None, help="sliding-window attention width")
     parser.add_argument("--pack", action="store_true", help="pack a variable-length corpus (segment_ids path)")
     parser.add_argument("--remat", action="store_true", help="recompute blocks in the backward pass (long-context memory)")
+    parser.add_argument("--tie-embeddings", action="store_true", help="share the embedding matrix with the LM head")
     parser.add_argument("--mesh", type=str, default=None, help="e.g. data=2,fsdp=4")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
     parser.add_argument("--ema", type=float, default=0.0, help="param EMA decay (0 off); validation uses the average")
@@ -185,6 +190,7 @@ def main():
         "n_seqs": args.n_seqs,
         "lr": args.lr,
         "attn": args.attn,
+        "tie_embeddings": args.tie_embeddings,
         "remat": args.remat,
         "window": args.window,
         "pack": args.pack,

@@ -7,7 +7,7 @@ import numpy as np
 import optax
 
 import dmlcloud_tpu as dml
-from dmlcloud_tpu.utils.profiling import chip_peak_flops
+from dmlcloud_tpu.utils import profiling
 
 
 class _FlopsStage(dml.TrainValStage):
@@ -39,7 +39,11 @@ class _FlopsStage(dml.TrainValStage):
         pass
 
 
-def test_mfu_tracked_per_epoch():
+def test_mfu_tracked_per_epoch(monkeypatch):
+    # give the CPU device kind an entry so the metric is tracked here the
+    # way it would be on a real chip
+    kind = jax.local_devices()[0].device_kind.lower()
+    monkeypatch.setitem(profiling.PEAK_BF16_FLOPS, kind, 197e12)
     pipe = dml.TrainingPipeline(name="mfu-test")
     stage = _FlopsStage()
     pipe.append_stage(stage, max_epochs=2)
@@ -48,9 +52,24 @@ def test_mfu_tracked_per_epoch():
     assert len(hist) == 2 and all(v is not None and v > 0 for v in hist)
     # consistency: mfu == flops/step / step_time / total_peak
     step_ms = stage.tracker["misc/train_step_avg_ms"][-1]
-    peak_total = chip_peak_flops() * int(pipe.mesh.devices.size)
+    peak_total = profiling.chip_peak_flops() * int(pipe.mesh.devices.size)
     expected = 1.0e9 / (step_ms / 1e3) / peak_total
     np.testing.assert_allclose(hist[-1], expected, rtol=1e-6)
+
+
+def test_mfu_skipped_on_unknown_device_kind():
+    # CPU (and any backend outside the bf16 peak table) gets NO misc/mfu
+    # rather than a number computed against a made-up TPU peak
+    if profiling.peak_flops_for_kind(jax.local_devices()[0].device_kind) is not None:
+        import pytest
+
+        pytest.skip("running on a device with a known peak; skip path untestable")
+    pipe = dml.TrainingPipeline(name="mfu-unknown")
+    stage = _FlopsStage()
+    pipe.append_stage(stage, max_epochs=1)
+    pipe.run()
+    assert "misc/mfu" not in stage.tracker
+    assert stage.tracker["misc/train_step_avg_ms"]  # step timing still tracked
 
 
 def test_mfu_absent_when_disabled():

@@ -99,6 +99,42 @@ def test_init_and_object_collectives(tmp_path):
     )
 
 
+def test_divergent_collective_call_sites_fail_loudly(tmp_path):
+    """A rank-conditional collective pairing two DIFFERENT call sites must
+    raise CollectiveMismatchError on the receiver — not silently deliver
+    whatever object the other rank happened to publish at that sequence
+    number (runtime.py's _seq counters assume identical call sequences).
+    An explicit shared tag= opts intentional cross-site pairs back in."""
+    _spawn(
+        tmp_path,
+        """
+        # the corruption scenario: rank 0 publishes from one call site while
+        # rank 1 receives at the same sequence number from another
+        if RANK == 0:
+            rt.broadcast_object({"secret": 42})
+            print("DIVERGE-OK", RANK)
+        else:
+            try:
+                rt.broadcast_object(None)
+            except rt.CollectiveMismatchError as e:
+                assert "diverged" in str(e), e
+                assert "tag=" in str(e), e
+                print("DIVERGE-OK", RANK)
+            else:
+                raise SystemExit("expected CollectiveMismatchError, got an object")
+        rt.barrier("resync", timeout=60)
+
+        # intentional cross-site pairing: an explicit shared tag makes it legal
+        if RANK == 0:
+            got = rt.broadcast_object({"cfg": 7}, tag="cfg-exchange")
+        else:
+            got = rt.broadcast_object(tag="cfg-exchange")
+        assert got == {"cfg": 7}, got
+        print("TAGGED-OK", RANK)
+        """,
+    )
+
+
 def test_fused_metric_exchange(tmp_path):
     """The packed single-collective epoch exchange across real processes:
     MEAN/SUM/MIN/MAX combine correctly, local metrics stay local, and every
