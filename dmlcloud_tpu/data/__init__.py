@@ -12,6 +12,7 @@ from .datasets import (
 )
 from .device import device_iterator
 from .sharding import chunk_and_shard_indices, shard_indices, shard_sequence
+from .synthetic import markov_tokens
 
 __all__ = [
     "BatchDataset",
@@ -25,6 +26,7 @@ __all__ = [
     "pack_sequences",
     "sharded_xr_dataset",
     "device_iterator",
+    "markov_tokens",
     "chunk_and_shard_indices",
     "shard_indices",
     "shard_sequence",

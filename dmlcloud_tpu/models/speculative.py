@@ -70,6 +70,7 @@ def _row_spec_decode(
     temperature,  # traced scalar — a new value must not recompile
     sampled: bool,  # static: selects the greedy or rejection-sampling body
     ragged: bool,  # static: False keeps the pad_len=None fast path compiled
+    return_stats: bool = False,  # static: also return (rounds, generated)
 ):
     from .generate import init_cache
     from .quant import dequant_tree
@@ -120,6 +121,9 @@ def _row_spec_decode(
         "tcache": tcache,
         "dcache": dcache,
         "done": first_tok == eos_id,
+        # verification rounds run (one target pass each) — the accept-rate
+        # observable: generated = 1 + sum(n_accept_r + 1) over rounds
+        "rounds": jnp.asarray(0, jnp.int32),
     }
 
     def cond(s):
@@ -226,6 +230,7 @@ def _row_spec_decode(
             "tcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["tcache"], tcache),
             "dcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["dcache"], dcache),
             "done": done_row | hit_eos,
+            "rounds": jnp.where(done_row, s["rounds"], s["rounds"] + 1),
         }
         return new_state
 
@@ -234,21 +239,30 @@ def _row_spec_decode(
     # positions past the fill (loop exited with pos < t+max_new on eos)
     fill = state["pos"] - t
     out = jnp.where(jnp.arange(max_new_tokens) < fill, out, pad_id)
+    if return_stats:
+        # UNCLAMPED advance: the final round may overshoot max_new_tokens by
+        # up to k (the surplus is masked out of `out` above). Returning the
+        # true advance keeps the accept-rate algebra exact:
+        # advanced - 1 == sum over rounds of (n_accept_r + 1).
+        return out, (state["rounds"], fill)
     return out
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled", "ragged"),
+    static_argnames=(
+        "target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled", "ragged",
+        "return_stats",
+    ),
 )
 def _spec_compiled(
     target, draft, target_params, draft_params, prompt, rng, pad_len, temperature,
-    max_new_tokens, k, eos_id, pad_id, sampled, ragged,
+    max_new_tokens, k, eos_id, pad_id, sampled, ragged, return_stats=False,
 ):
     row_fn = functools.partial(
         _row_spec_decode, target, draft,
         max_new_tokens=max_new_tokens, k=k, eos_id=eos_id, pad_id=pad_id,
-        temperature=temperature, sampled=sampled, ragged=ragged,
+        temperature=temperature, sampled=sampled, ragged=ragged, return_stats=return_stats,
     )
     row_keys = jax.random.split(rng, prompt.shape[0])
     return jax.vmap(
@@ -270,6 +284,7 @@ def speculative_generate(
     prompt_mask: jnp.ndarray | None = None,
     eos_id: int = -1,
     pad_id: int = 0,
+    return_stats: bool = False,
 ):
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, T] using
     ``draft`` to propose ``k`` tokens per target verification pass: at
@@ -285,7 +300,16 @@ def speculative_generate(
     int8 weight-only quantized (models/quant.py). Ragged prompts work like
     ``generate``: LEFT-pad and pass ``prompt_mask`` ([B, T] {0,1}, zeros
     first). The temperature value is traced (sweeping it does not
-    recompile); only the greedy-vs-sampled switch is compiled in."""
+    recompile); only the greedy-vs-sampled switch is compiled in.
+
+    ``return_stats=True`` additionally returns ``(rounds, advanced)`` int32
+    arrays [B]: verification rounds run (= target decode passes) and
+    positions the decode loop advanced per row — ``advanced`` can exceed
+    ``max_new_tokens`` by up to ``k`` when the final round overshoots (the
+    surplus tokens are masked out of the returned sequence). Each round
+    accepts ``n_accept`` draft proposals plus one target token (and the
+    first token costs no round), so absent eos the per-row draft accept
+    rate is exactly ``(advanced - 1 - rounds) / (rounds * k)``."""
     prompt = jnp.asarray(prompt, jnp.int32)
     _, t = prompt.shape
     if k < 1:
@@ -313,4 +337,5 @@ def speculative_generate(
         target, draft, target_params, draft_params, prompt, rng, pad_len,
         jnp.float32(max(float(temperature), 1e-6)),
         int(max_new_tokens), int(k), int(eos_id), int(pad_id), float(temperature) > 0.0, ragged,
+        return_stats=bool(return_stats),
     )

@@ -40,6 +40,27 @@ DATA, FSDP, MODEL, SEQ, EXPERT, PIPE = "data", "fsdp", "model", "seq", "expert",
 PartitionRules = Sequence[tuple[str, P]]
 
 
+def parse_mesh_axes(spec: str) -> dict[str, int]:
+    """Parse a CLI mesh spec like ``'data=2,fsdp=4'`` into an axes dict for
+    :func:`create_mesh` / ``TrainingPipeline.set_mesh`` (``-1`` absorbs the
+    remaining devices). One shared parser so every example/CLI rejects a
+    malformed spec with the same actionable error."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        try:
+            if not (name and eq):
+                raise ValueError
+            axes[name] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"malformed mesh spec {spec!r}: expected comma-separated name=int "
+                f"pairs like 'data=2,fsdp=4' (bad part: {part!r})"
+            ) from None
+    return axes
+
+
 def create_mesh(
     axes: Mapping[str, int] | None = None,
     devices: Sequence[jax.Device] | None = None,

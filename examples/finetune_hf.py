@@ -28,7 +28,7 @@ import optax
 import dmlcloud_tpu as dml
 from dmlcloud_tpu.data import pack_sequences
 from dmlcloud_tpu.models.transformer import DecoderLM, lm_loss
-from dmlcloud_tpu.parallel import init_auto, runtime
+from dmlcloud_tpu.parallel import init_auto, parse_mesh_axes, runtime
 
 
 def build_hf_model(name: str | None):
@@ -166,7 +166,7 @@ def main():
 
     pipeline = dml.TrainingPipeline({"seed": 0, "lr": args.lr}, name="finetune-hf")
     if args.mesh:
-        axes = {k: int(v) for k, v in (kv.split("=") for kv in args.mesh.split(","))}
+        axes = parse_mesh_axes(args.mesh)
         pipeline.set_mesh(axes)
     stage = FinetuneStage(model, cfg, params, args.seq_len, args.batch_size, args.n_docs, args.lr, lora_rank=args.lora)
     pipeline.append_stage(stage, max_epochs=args.epochs)

@@ -20,7 +20,7 @@ from dmlcloud_tpu.models.transformer import (
     llama_partition_rules,
     lm_loss,
 )
-from dmlcloud_tpu.parallel import init_auto
+from dmlcloud_tpu.parallel import init_auto, parse_mesh_axes
 
 PRESETS = {
     "tiny": dict(num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16, hidden_dim=64, mlp_dim=160),
@@ -29,17 +29,7 @@ PRESETS = {
 }
 
 
-def synthetic_tokens(vocab_size: int, n_seqs: int, seq_len: int, seed: int = 0) -> np.ndarray:
-    """A learnable synthetic corpus: Markov-ish token chains, so loss actually
-    drops and the example demonstrates real optimisation."""
-    rng = np.random.RandomState(seed)
-    next_tok = rng.randint(0, vocab_size, size=vocab_size)
-    toks = np.empty((n_seqs, seq_len), np.int32)
-    toks[:, 0] = rng.randint(0, vocab_size, size=n_seqs)
-    noise = rng.rand(n_seqs, seq_len) < 0.1
-    for t in range(1, seq_len):
-        toks[:, t] = np.where(noise[:, t], rng.randint(0, vocab_size, size=n_seqs), next_tok[toks[:, t - 1]])
-    return toks
+from dmlcloud_tpu.data import markov_tokens as synthetic_tokens  # noqa: E402 — learnable corpus
 
 
 class LMStage(dml.TrainValStage):
@@ -202,7 +192,7 @@ def main():
     }
     pipeline = dml.TrainingPipeline(config, name=f"lm-{args.preset}")
     if args.mesh:
-        axes = {k: int(v) for k, v in (kv.split("=") for kv in args.mesh.split(","))}
+        axes = parse_mesh_axes(args.mesh)
         pipeline.set_mesh(axes)
     if args.checkpoint_dir:
         pipeline.enable_checkpointing(args.checkpoint_dir)

@@ -201,3 +201,37 @@ def test_length_guard(models):
     prompt = jnp.zeros((1, 90), jnp.int32)
     with pytest.raises(ValueError, match="max_seq_len"):
         speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=10, k=4)
+
+
+def test_return_stats_consistency(models):
+    """rounds/generated must obey the accept-rate algebra: every round emits
+    between 1 and k+1 tokens (so rounds bounds generated-1 from both sides),
+    a perfect draft needs the fewest rounds, and the derived accept rate for
+    the SAME-model draft is exactly 1."""
+    target, tparams, draft, dparams = models
+    k = 3
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 48, (3, 9)), jnp.int32)
+    toks, (rounds, generated) = speculative_generate(
+        target, tparams, draft, dparams, prompt, max_new_tokens=18, k=k, return_stats=True
+    )
+    want = np.asarray(
+        speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=18, k=k)
+    )
+    np.testing.assert_array_equal(np.asarray(toks), want)  # stats don't change tokens
+    rounds, generated = np.asarray(rounds), np.asarray(generated)
+    # no eos id in play: full fill, plus up to k overshoot in the last round
+    assert ((generated >= 18) & (generated <= 18 + k)).all(), generated
+    # each round advances 1..k+1 positions (first token costs no round)
+    assert (rounds >= np.ceil((generated - 1) / (k + 1))).all(), (rounds, generated)
+    assert (rounds <= generated - 1).all(), (rounds, generated)
+    rate = (generated - 1 - rounds) / (rounds * k)
+    assert ((rate >= 0) & (rate <= 1)).all(), rate
+
+    # a perfect draft (the target itself) accepts every proposal
+    _, (p_rounds, p_generated) = speculative_generate(
+        target, tparams, target, tparams, prompt, max_new_tokens=18, k=k, return_stats=True
+    )
+    p_rounds, p_generated = np.asarray(p_rounds), np.asarray(p_generated)
+    p_rate = (p_generated - 1 - p_rounds) / (p_rounds * k)
+    np.testing.assert_allclose(p_rate, 1.0)
+    assert (p_rounds <= rounds).all(), (p_rounds, rounds)
