@@ -184,25 +184,30 @@ def bench_framework(batch) -> float:
     return TIMED_STEPS * batch_size / (marks[1] - marks[0])
 
 
-def _lm_model(s=1024, layers=12, vocab=32000):
+def _lm_model(s=1024, layers=12, vocab=32000, hidden=768, heads=12, kv=4, head_dim=64,
+              mlp=2048, remat=False):
     from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 
     cfg = TransformerConfig(
-        vocab_size=vocab, num_layers=layers, num_heads=12, num_kv_heads=4, head_dim=64,
-        hidden_dim=768, mlp_dim=2048, max_seq_len=s, dtype=jnp.bfloat16, attn_impl="flash",
+        vocab_size=vocab, num_layers=layers, num_heads=heads, num_kv_heads=kv,
+        head_dim=head_dim, hidden_dim=hidden, mlp_dim=mlp, max_seq_len=s,
+        dtype=jnp.bfloat16, attn_impl="flash", remat=remat,
     )
     return DecoderLM(cfg), cfg
 
 
-def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000):
-    """Decoder-LM training throughput (tokens/s/chip): Llama-style 12-layer
-    bf16 model, flash attention, donated jitted step. MFU uses the standard
-    6·params FLOPs/token training estimate."""
+def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000, vocab_chunk=0, **model_kw):
+    """Decoder-LM training throughput (tokens/s/chip): Llama-style bf16
+    model, flash attention, donated jitted step. MFU uses the standard
+    6·params FLOPs/token training estimate. ``vocab_chunk > 0`` computes the
+    loss via chunked_lm_loss (no [B,S,V] logits materialized) instead of the
+    full-logits path — same model, same tokens, so the ratio of the two is
+    the chunked-loss overhead (or win) at this vocab."""
     import jax.tree_util as jtu
 
-    from dmlcloud_tpu.models.transformer import lm_loss
+    from dmlcloud_tpu.models.transformer import chunked_lm_loss, lm_loss
 
-    model, cfg = _lm_model(s, layers, vocab)
+    model, cfg = _lm_model(s, layers, vocab, **model_kw)
     tokens = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (b, s)), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:1, :8])["params"]
     # MFU counts matmul params only (PaLM convention): the embedding table
@@ -216,6 +221,11 @@ def bench_lm(iters=15, b=8, s=1024, layers=12, vocab=32000):
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt, tokens):
         def loss_fn(p):
+            if vocab_chunk > 0:
+                hidden_out = model.apply({"params": p}, tokens, return_hidden=True)
+                return chunked_lm_loss(
+                    hidden_out, p["lm_head"]["kernel"], tokens, vocab_chunk=vocab_chunk
+                )
             return lm_loss(model.apply({"params": p}, tokens), tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -308,6 +318,104 @@ def bench_decode(b=8, prompt_len=128, new_tokens=512, layers=12, vocab=32000, re
     except Exception as e:  # quantized path must not cost the bf16 number
         print(f"child: int8 decode bench failed: {type(e).__name__}: {e}", file=sys.stderr)
     return tps, int8_tps
+
+
+def bench_speculative(b=8, prompt_len=64, new_tokens=256, k=4, vocab=512,
+                      train_steps=400, train_b=32, train_s=128, reps=3,
+                      target_layers=12, draft_layers=2, lr=1e-3, **model_kw):
+    """Speculative-decoding speedup over plain greedy decode of the SAME
+    target, plus the measured draft accept rate (models/speculative.py).
+
+    Target (12L/768d) and draft (2L/768d) are first trained for a few
+    seconds on a learnable synthetic corpus so the draft actually agrees
+    with the target — speculation's win depends on the accept rate, so a
+    bench against an unlearnable distribution would measure nothing real.
+    Returns (plain_tps, spec_tps, accept_rate, k, target_loss, draft_loss);
+    the two final train losses are the published learnedness gate — an
+    accept rate only means something when both sit near the corpus's
+    ~0.9-nat entropy floor (not far above = unlearned, not ~0 = memorized)."""
+    from dmlcloud_tpu.data import markov_tokens
+    from dmlcloud_tpu.models.generate import generate
+    from dmlcloud_tpu.models.speculative import speculative_generate
+    from dmlcloud_tpu.models.transformer import lm_loss
+
+    max_len = prompt_len + new_tokens + k + 1
+    target, _ = _lm_model(s=max_len, layers=target_layers, vocab=vocab, **model_kw)
+    draft, _ = _lm_model(s=max_len, layers=draft_layers, vocab=vocab, **model_kw)
+    # MANY distinct batches, cycled: training on one fixed batch memorizes
+    # the noisy sequences (loss -> 0) instead of learning the successor
+    # table, and a memorizer agrees with nothing on fresh prompts
+    n_batches = min(train_steps, 16)
+    corpus = markov_tokens(vocab, train_b * n_batches, train_s)
+    batches = [
+        jnp.asarray(corpus[i * train_b:(i + 1) * train_b], jnp.int32) for i in range(n_batches)
+    ]
+
+    def train(model, seed):
+        params = model.init(jax.random.PRNGKey(seed), batches[0][:1, :8])["params"]
+        tx = optax.adamw(lr)
+        opt = tx.init(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(model.apply({"params": p}, tokens), tokens)
+            )(params)
+            up, new_opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, up), new_opt, loss
+
+        for i in range(train_steps):
+            params, opt, loss = step(params, opt, batches[i % n_batches])
+        return params, float(loss)
+
+    tparams, target_loss = train(target, 0)
+    dparams, draft_loss = train(draft, 1)
+    # fresh prompts from the SAME successor table the models trained on
+    prompt = jnp.asarray(markov_tokens(vocab, b, prompt_len, seed=7, table_seed=0), jnp.int32)
+
+    def timed(fn):
+        np.asarray(fn())  # compile + sync
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn())
+            best = min(best, time.perf_counter() - t0)
+        return b * new_tokens / best
+
+    plain_tps = timed(lambda: generate(target, tparams, prompt, new_tokens))
+
+    # ONE compiled spec program: the stats ride the timed variant (greedy is
+    # deterministic, so every rep returns identical rounds/advance)
+    stats = {}
+
+    def spec_fn():
+        toks, stats["rg"] = speculative_generate(
+            target, tparams, draft, dparams, prompt, new_tokens, k=k, return_stats=True
+        )
+        return toks
+
+    spec_tps = timed(spec_fn)
+    rounds, generated = (np.asarray(x, np.float64) for x in stats["rg"])
+    accept_rate = float(np.mean((generated - 1 - rounds) / np.maximum(rounds * k, 1)))
+    return plain_tps, spec_tps, accept_rate, k, target_loss, draft_loss
+
+
+def bench_lm_scale(b=4, s=1024, iters=8, **model_kw):
+    """Scale-up MFU datapoint: a 24L/1024d model (≈370M matmul params),
+    remat OFF vs ON at the same batch — shows whether the framework's step
+    holds MFU as the model grows and what recomputation costs.
+    Returns {"tps": .., "mfu": .., "tps_remat": .., "mfu_remat": ..}."""
+    big = dict(layers=24, vocab=32000, hidden=1024, heads=16, kv=8, head_dim=64, mlp=2816)
+    big.update(model_kw)
+    out = {}
+    try:
+        tps, mfu = bench_lm(iters=iters, b=b, s=s, **big)
+        out["tps"], out["mfu"] = tps, mfu
+    except Exception as e:  # noqa: BLE001 — e.g. HBM exhaustion without remat
+        print(f"child: 24L no-remat bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+    tps_r, mfu_r = bench_lm(iters=iters, b=b, s=s, remat=True, **big)
+    out["tps_remat"], out["mfu_remat"] = tps_r, mfu_r
+    return out
 
 
 def bench_flash(seq=8192, b=2, h=8, d=64, iters=20):
@@ -501,9 +609,10 @@ except ValueError:
     _RETRY_BACKOFF_S = (30, 90)
 
 #: Hard cap on one child attempt. Generous: first-compile on the tunnel is
-#: slow (~40s each for ~6 distinct programs) and the sub-benches together
-#: run a few minutes when healthy.
-_CHILD_TIMEOUT_S = 1800
+#: slow (~40s each for ~10 distinct programs) and the sub-benches together
+#: run several minutes when healthy (incl. the speculative bench's short
+#: training runs and the 24L scale-up pair).
+_CHILD_TIMEOUT_S = 2400
 
 
 def _sub_bench(results: dict, errors: list, name: str, fn):
@@ -578,8 +687,20 @@ def child_main():
     if smoke:
         _sub_bench(results, errors, "decode", lambda: list(bench_decode(
             b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1)))
+        _sub_bench(results, errors, "speculative", lambda: list(bench_speculative(
+            b=2, prompt_len=16, new_tokens=32, k=2, vocab=128, train_steps=5,
+            train_b=4, train_s=32, reps=1, target_layers=2, draft_layers=1,
+            hidden=64, heads=4, kv=2, head_dim=16, mlp=128)))
+        _sub_bench(results, errors, "chunked_lm",
+                   lambda: bench_lm(iters=2, vocab_chunk=128, **lm_shape)[0])
+        _sub_bench(results, errors, "lm_scale", lambda: bench_lm_scale(
+            b=1, s=64, iters=1, layers=2, vocab=256, hidden=64, heads=4, kv=2,
+            head_dim=16, mlp=128))
     else:
         _sub_bench(results, errors, "decode", lambda: list(bench_decode()))
+        _sub_bench(results, errors, "speculative", lambda: list(bench_speculative()))
+        _sub_bench(results, errors, "chunked_lm", lambda: bench_lm(vocab_chunk=4096)[0])
+        _sub_bench(results, errors, "lm_scale", lambda: bench_lm_scale())
     results["errors"] = errors
     results["peak_flops"] = chip_peak_flops()
     results["device_kind"] = jax.devices()[0].device_kind
@@ -661,6 +782,9 @@ def main():
     flash = tpu.get("flash") or [None, None, None, None]
     decode = tpu.get("decode") or [None, None]
     lm = tpu.get("lm") or {}
+    spec = tpu.get("speculative") or [None] * 6
+    chunked_tps = tpu.get("chunked_lm")
+    lm_scale = tpu.get("lm_scale") or {}
     value = fw_ips if fw_ips is not None else raw_ips
     print(
         json.dumps(
@@ -693,12 +817,37 @@ def main():
                     "decode_int8_speedup": _rnd(
                         decode[1] / decode[0] if decode[0] and decode[1] else None, 3
                     ),
+                    "spec_decode_plain_tokens_per_sec_b8_p64_n256": _rnd(spec[0], 1),
+                    "spec_decode_tokens_per_sec_b8_p64_n256": _rnd(spec[1], 1),
+                    "spec_decode_speedup_vs_plain": _rnd(
+                        spec[1] / spec[0] if spec[0] and spec[1] else None, 3
+                    ),
+                    "spec_decode_accept_rate": _rnd(spec[2], 4),
+                    "spec_decode_k": spec[3],
+                    # learnedness gate: the accept rate is only meaningful
+                    # with both losses near the corpus's ~0.9-nat floor
+                    "spec_decode_train_loss_target": _rnd(spec[4], 3),
+                    "spec_decode_train_loss_draft": _rnd(spec[5], 3),
+                    "lm_train_tokens_per_sec_chunked_loss_c4096": _rnd(chunked_tps, 1),
+                    "chunked_loss_ratio_vs_full": _rnd(
+                        chunked_tps / lm["raw_tps"] if chunked_tps and lm.get("raw_tps") else None, 4
+                    ),
+                    "lm_train_tokens_per_sec_24l_1024d_s1k": _rnd(lm_scale.get("tps"), 1),
+                    "lm_train_mfu_24l_1024d": _rnd(lm_scale.get("mfu"), 4),
+                    "lm_train_tokens_per_sec_24l_1024d_s1k_remat": _rnd(lm_scale.get("tps_remat"), 1),
+                    "lm_train_mfu_24l_1024d_remat": _rnd(lm_scale.get("mfu_remat"), 4),
                     "metrics_allreduce_p50_ms_8proc_12metrics": _rnd(metrics_p50, 3),
                     "metrics_allreduce_p50_ms_8proc_12metrics_reference_pattern": _rnd(
                         metrics_ref_p50, 3
                     ),
                     "metrics_exchange_speedup_vs_reference_pattern": _rnd(
                         metrics_ref_p50 / metrics_p50 if metrics_p50 and metrics_ref_p50 else None, 2
+                    ),
+                    # NOT an ICI latency: this environment has one chip, so the
+                    # exchange is measured across coordinated host processes
+                    "metrics_allreduce_measurement_env": (
+                        "8 coordinated CPU processes, one host (loopback gRPC/gloo); "
+                        "TPU-pod ICI unavailable in this single-chip environment"
                     ),
                     "device_kind": tpu.get("device_kind"),
                     "bench_errors": tpu.get("errors") or (["tpu child never returned results"] if not tpu else []),
