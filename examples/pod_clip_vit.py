@@ -1,0 +1,225 @@
+"""Pod-scale recipe: CLIP (ViT-L/16 vision tower) contrastive pretraining
+with Orbax checkpoints to GCS — BASELINE.json config 4.
+
+The reference leaves model+scale choices to users (it ships no vision or
+contrastive stack at all — /root/reference/dmlcloud/pipeline.py:55-75); this
+recipe is the committed, runnable shape of that configuration on a TPU pod.
+
+## The v5p-64 recipe (16 hosts x 4 chips)
+
+    srun python examples/pod_clip_vit.py \
+        --preset vit-l --mesh data=8,fsdp=8 \
+        --global-batch 4096 --epochs 32 \
+        --checkpoint-dir gs://YOUR_BUCKET/runs/clip-vit-l \
+        --save-every-steps 500
+
+Every choice, spelled out:
+
+- **Mesh `data=8, fsdp=8`** (64 chips): CLIP-L at batch 4096 is data-
+  parallel-friendly (410M params), but pure DP replicates ~4.9 GB of
+  fp32 param+Adam state per chip; sharding it over ``fsdp=8`` cuts that to
+  ~0.6 GB and the batch still spans BOTH axes (the framework shards the
+  batch over ``data`` x ``fsdp`` — parallel/mesh.py ``data_axes``), so the
+  contrastive loss still sees all 4096 pairs in one jit program: XLA
+  all-gathers the embeddings ([4096, 512] fp32 = 16 MB — nothing) for the
+  similarity matmul, NOT the images.
+- **Partition rules**: ``encoder_partition_rules()`` (models/encoder.py) —
+  attention/MLP kernels ``P('fsdp', 'model')``; with no ``model`` axis in
+  this mesh that collapses to plain FSDP sharding. Add ``model=4`` (e.g.
+  ``data=4,fsdp=4,model=4``) only past ~ViT-g scale, where per-layer
+  weights stop fitting comfortably.
+- **Per-host batch** = global / num_hosts = 4096/16 = **256** — each host's
+  input pipeline loads 256 (image, text) pairs per step;
+  ``make_global_batch`` stitches the host shards into the one global array
+  (parallel/mesh.py:252).
+- **Checkpoints**: ``enable_checkpointing('gs://...')`` writes the run dir
+  (config.yaml, log.txt, checkpoint dir contract — checkpoint.py:145) and
+  Orbax tensor state straight to GCS; each of the 16 processes writes only
+  its own param shards (Orbax OCDBT), so checkpoint bandwidth scales with
+  hosts. ``--save-every-steps 500`` bounds preemption loss to ~500 steps;
+  epoch saves + ``misc/`` step counters make mid-epoch resume bit-exact
+  (--resume, tests/test_step_checkpoint.py).
+
+## Toy run (any machine, e.g. the 8-device CPU mesh)
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/pod_clip_vit.py --toy --mesh data=2,fsdp=4
+
+Same code path end to end (mesh, rules, contrastive loss, Orbax saves) on
+a tiny CLIP and synthetic data; only the sizes differ.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.models.clip import CLIP, CLIPConfig, CLIPTextConfig, clip_loss
+from dmlcloud_tpu.models.encoder import encoder_partition_rules
+from dmlcloud_tpu.models.vit import ViTConfig
+from dmlcloud_tpu.parallel import init_auto, parse_mesh_axes, runtime
+
+PRESETS = {
+    # ViT-L/16 vision tower + the standard CLIP text tower; 24L/1024d vision
+    "vit-l": dict(
+        vision=dict(image_size=224, patch_size=16, hidden_dim=1024, num_layers=24,
+                    num_heads=16, mlp_dim=4096, num_classes=0),
+        text=dict(vocab_size=49408, max_seq_len=77, hidden_dim=768, num_layers=12,
+                  num_heads=12, mlp_dim=3072),
+        embed_dim=768,
+    ),
+    "toy": dict(
+        vision=dict(image_size=32, patch_size=8, hidden_dim=32, num_layers=2,
+                    num_heads=2, mlp_dim=64, num_classes=0, dtype=jnp.float32),
+        text=dict(vocab_size=128, max_seq_len=16, hidden_dim=32, num_layers=2,
+                  num_heads=2, mlp_dim=64, dtype=jnp.float32),
+        embed_dim=32,
+    ),
+}
+
+
+def build_clip(preset: str) -> CLIP:
+    p = PRESETS[preset]
+    return CLIP(CLIPConfig(
+        embed_dim=p["embed_dim"],
+        vision=ViTConfig(**p["vision"]),
+        text=CLIPTextConfig(**p["text"]),
+    ))
+
+
+class SyntheticPairs:
+    """Correlated (image, text) pairs, generated PER STEP: image class k has
+    mean brightness k/8 and caption tokens from a class-specific band, so
+    the contrastive objective has real signal and in-batch accuracy rises.
+
+    Re-iterable (each epoch regenerates the same batches from ``seed``) and
+    lazy — one batch of float32 lives at a time. At the documented recipe
+    scale, materialising an epoch up front would be ~15 GB of images per
+    host; a step is ~150 MB."""
+
+    def __init__(self, cfg: CLIPConfig, batch: int, steps: int, seed: int = 0):
+        self.cfg, self.batch, self.steps, self.seed = cfg, batch, steps, seed
+
+    def __len__(self):
+        return self.steps
+
+    def __iter__(self):
+        cfg, rng = self.cfg, np.random.default_rng(self.seed)
+        size = cfg.vision.image_size
+        # bands span [0, vocab-1) so no caption token collides with the EOT
+        # id (argmax pooling in CLIPTextTower must find the appended EOT)
+        band = (cfg.text.vocab_size - 1) // 8
+        for _ in range(self.steps):
+            classes = rng.integers(0, 8, size=self.batch)
+            imgs = rng.random((self.batch, size, size, 3), dtype=np.float32) * 0.3
+            imgs += (classes / 8.0).astype(np.float32)[:, None, None, None]
+            toks = rng.integers(0, band, size=(self.batch, cfg.text.max_seq_len))
+            toks += (classes * band)[:, None]
+            # CLIP convention: EOT token = highest id in the row
+            toks[:, -1] = cfg.text.vocab_size - 1
+            yield {"image": imgs, "tokens": toks.astype(np.int32)}
+
+
+class CLIPStage(dml.TrainValStage):
+    def pre_stage(self):
+        cfg = self.config
+        model = build_clip(cfg.preset)
+        self.pipeline.register_model(
+            "clip",
+            model,
+            sharding=encoder_partition_rules(),
+            init_args=(
+                jnp.zeros((1,) + (model.cfg.vision.image_size,) * 2 + (3,), jnp.float32),
+                jnp.zeros((1, model.cfg.text.max_seq_len), jnp.int32),
+            ),
+        )
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, warmup_steps=cfg.warmup_steps, decay_steps=cfg.decay_steps
+        )
+        self.pipeline.register_optimizer(
+            "adamw", optax.adamw(schedule, weight_decay=0.2), scheduler=schedule
+        )
+
+        # per-HOST shard of the global batch (the pod recipe's 256-of-4096):
+        # every process loads its slice, make_global_batch (inside the stage
+        # feed) stitches them into the global array over data x fsdp
+        if cfg.global_batch % runtime.world_size():
+            raise ValueError(
+                f"--global-batch {cfg.global_batch} must divide evenly across "
+                f"{runtime.world_size()} processes"
+            )
+        per_host = cfg.global_batch // runtime.world_size()
+        self.pipeline.register_dataset(
+            "train",
+            SyntheticPairs(model.cfg, per_host, cfg.steps_per_epoch, seed=runtime.rank()),
+            verbose=False,
+        )
+
+    def checkpoint_every_steps(self):
+        return int(self.config.get("save_every_steps", 0))
+
+    def step(self, state, batch):
+        img_emb, txt_emb, scale = state.apply_fn(
+            {"params": state.params}, batch["image"], batch["tokens"], train=True
+        )
+        loss = clip_loss(img_emb, txt_emb, scale)
+        # in-batch retrieval accuracy: the live signal the loss should move
+        sim = img_emb @ txt_emb.T * scale
+        acc = jnp.mean(jnp.argmax(sim, axis=-1) == jnp.arange(sim.shape[0]))
+        return loss, {"accuracy": acc, "logit_scale": scale}
+
+    def val_epoch(self):  # contrastive pretrain: train metrics only
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="vit-l")
+    ap.add_argument("--toy", action="store_true", help="tiny model + tiny batch (sets --preset toy)")
+    ap.add_argument("--mesh", type=str, default="data=8,fsdp=8",
+                    help="v5p-64 default; use data=2,fsdp=4 for the 8-device CPU mesh")
+    ap.add_argument("--global-batch", type=int, default=4096)
+    ap.add_argument("--epochs", type=int, default=32)
+    ap.add_argument("--steps-per-epoch", type=int, default=100,
+                    help="synthetic-data epoch length (a real run sizes this from the dataset)")
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--checkpoint-dir", type=str, default=None,
+                    help="local path or gs://bucket/prefix (Orbax writes shards directly)")
+    ap.add_argument("--save-every-steps", type=int, default=500)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    if args.toy:
+        args.preset = "toy"
+        args.global_batch = min(args.global_batch, 16)
+        args.epochs = min(args.epochs, 2)
+        args.steps_per_epoch = min(args.steps_per_epoch, 4)
+
+    init_auto(verbose=True)
+
+    steps_total = args.epochs * args.steps_per_epoch
+    config = {
+        "preset": args.preset,
+        "global_batch": args.global_batch,
+        "steps_per_epoch": args.steps_per_epoch,
+        "lr": args.lr,
+        "warmup_steps": max(steps_total // 50, 1),
+        "decay_steps": steps_total,
+        "save_every_steps": args.save_every_steps,
+        "seed": 0,
+    }
+    pipeline = dml.TrainingPipeline(config, name=f"clip-{args.preset}")
+    axes = parse_mesh_axes(args.mesh)
+    pipeline.set_mesh(axes)
+    if args.checkpoint_dir:
+        pipeline.enable_checkpointing(args.checkpoint_dir, resume=args.resume)
+    stage = CLIPStage()
+    pipeline.append_stage(stage, max_epochs=args.epochs)
+    pipeline.run()
+    return stage
+
+
+if __name__ == "__main__":
+    main()
