@@ -96,14 +96,24 @@ def main():
         dcfg = dataclasses.replace(model.cfg, num_layers=1)
         draft = DecoderLM(dcfg)
         dparams = draft.init(jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32))["params"]
-        spec = speculative_generate(
+        spec, (rounds, advanced) = speculative_generate(
             model, params, draft, dparams, prompt, args.max_new, k=args.speculative,
             temperature=args.temperature, rng=jax.random.PRNGKey(args.seed),
-            prompt_mask=jnp.asarray(mask),
+            prompt_mask=jnp.asarray(mask), return_stats=True,
         )
         mode = "greedy" if args.temperature == 0 else f"sampled T={args.temperature}"
+        rounds, advanced = np.asarray(rounds, np.float64), np.asarray(advanced, np.float64)
         for row, toks in enumerate(np.asarray(spec)):
             print(f"row {row} (speculative k={args.speculative}, {mode}): {toks.tolist()}")
+        # max_new=1 needs no verification round; there is no rate to report
+        rate = (
+            f"{np.mean((advanced - 1 - rounds) / (rounds * args.speculative)):.2f}"
+            if rounds.min() > 0 else "n/a (no verification rounds)"
+        )
+        print(
+            f"target passes: {rounds.mean():.1f} rounds/row for {advanced.mean():.1f} tokens "
+            f"(draft accept rate {rate})"
+        )
         if args.temperature == 0:  # sampled mode matches in DISTRIBUTION, not per token
             plain = generate(model, params, prompt, args.max_new, prompt_mask=jnp.asarray(mask))
             print(f"matches plain greedy: {bool((np.asarray(spec) == np.asarray(plain)).all())}")
