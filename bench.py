@@ -656,6 +656,19 @@ def child_main():
     results: dict = {}
     errors: list = []
 
+    def checkpoint_results(final: bool = False):
+        """Print the marker line after EVERY sub-bench, not only at the end:
+        a tunnel that wedges mid-bench then costs only the unfinished tail —
+        the parent takes the LAST marker line it finds. Every snapshot
+        carries peak_flops/device_kind so MFU math never falls back to the
+        v5e stand-in just because the run ended early."""
+        snap = dict(results)
+        snap["errors"] = list(errors)
+        snap["partial"] = not final
+        snap["peak_flops"] = chip_peak_flops()
+        snap["device_kind"] = jax.devices()[0].device_kind
+        print(_CHILD_MARKER + json.dumps(snap), flush=True)
+
     def resnet():
         raw_by_batch, best_batch = _sweep_batches(
             BATCH_CANDIDATES,
@@ -702,42 +715,56 @@ def child_main():
             print(f"child: lm framework bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         return out
 
-    _sub_bench(results, errors, "resnet", resnet)
-    if smoke:
-        _sub_bench(results, errors, "flash", lambda: list(bench_flash(seq=512, b=1, h=2, iters=2)))
-    else:
-        _sub_bench(results, errors, "flash", lambda: list(bench_flash()))
-    _sub_bench(results, errors, "lm", lm)
-    if smoke:
-        _sub_bench(results, errors, "decode", lambda: list(bench_decode(
-            b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1)))
-        _sub_bench(results, errors, "speculative", lambda: list(bench_speculative(
-            b=2, prompt_len=16, new_tokens=32, k=2, vocab=128, train_steps=5,
-            train_b=4, train_s=32, reps=1, target_layers=2, draft_layers=1,
-            hidden=64, heads=4, kv=2, head_dim=16, mlp=128)))
-        _sub_bench(results, errors, "chunked_lm",
-                   lambda: bench_lm(iters=2, b=2, vocab_chunk=128, **lm_shape)[0])
-        _sub_bench(results, errors, "lm_scale", lambda: bench_lm_scale(
-            b=1, s=64, iters=1, layers=2, vocab=256, hidden=64, heads=4, kv=2,
-            head_dim=16, mlp=128))
-    else:
-        _sub_bench(results, errors, "decode", lambda: list(bench_decode()))
-        _sub_bench(results, errors, "speculative", lambda: list(bench_speculative()))
-        # chunked-loss at the SAME batch the headline LM number used, so the
-        # ratio is batch-for-batch
-        _sub_bench(results, errors, "chunked_lm", lambda: bench_lm(
-            b=(results.get("lm") or {}).get("batch_size") or 8, vocab_chunk=4096)[0])
-        _sub_bench(results, errors, "lm_scale", lambda: bench_lm_scale())
-    results["errors"] = errors
-    results["peak_flops"] = chip_peak_flops()
-    results["device_kind"] = jax.devices()[0].device_kind
-    print(_CHILD_MARKER + json.dumps(results), flush=True)
+    # ONE plan; smoke mode only swaps in tiny shapes per sub-bench
+    tiny = dict(hidden=64, heads=4, kv=2, head_dim=16, mlp=128)
+    flash_kw = dict(seq=512, b=1, h=2, iters=2) if smoke else {}
+    decode_kw = dict(b=2, prompt_len=16, new_tokens=32, layers=2, vocab=512, reps=1) if smoke else {}
+    spec_kw = dict(
+        b=2, prompt_len=16, new_tokens=32, k=2, vocab=128, train_steps=5,
+        train_b=4, train_s=32, reps=1, target_layers=2, draft_layers=1, **tiny,
+    ) if smoke else {}
+    scale_kw = dict(b=1, s=64, iters=1, layers=2, vocab=256, **tiny) if smoke else {}
+
+    def chunked_kw():
+        if smoke:
+            return dict(iters=2, b=2, vocab_chunk=128, **lm_shape)
+        # chunked-loss at the SAME batch the headline LM number used, so
+        # the ratio is batch-for-batch (read lazily: lm has run by then)
+        return dict(b=(results.get("lm") or {}).get("batch_size") or 8, vocab_chunk=4096)
+
+    plan = [
+        ("resnet", resnet),
+        ("flash", lambda: list(bench_flash(**flash_kw))),
+        ("lm", lm),
+        ("decode", lambda: list(bench_decode(**decode_kw))),
+        ("speculative", lambda: list(bench_speculative(**spec_kw))),
+        ("chunked_lm", lambda: bench_lm(**chunked_kw())[0]),
+        ("lm_scale", lambda: bench_lm_scale(**scale_kw)),
+    ]
+    for name, fn in plan:
+        _sub_bench(results, errors, name, fn)
+        checkpoint_results()
+    checkpoint_results(final=True)
+
+
+def _richness(snap: dict) -> int:
+    """How many sub-benches a snapshot actually completed."""
+    return sum(1 for k, v in snap.items() if v is not None and k not in (
+        "errors", "partial", "peak_flops", "device_kind"))
 
 
 def _run_tpu_child():
     """Launch the TPU child with retry+backoff; return its results dict or
-    None when every attempt failed (tunnel down for the whole window)."""
+    None when every attempt failed (tunnel down for the whole window).
+
+    A FINAL marker (all sub-benches ran) returns immediately. A partial
+    marker from a timed-out child is returned as-is — the tunnel wedged and
+    a retry would burn another _CHILD_TIMEOUT_S with little chance of a
+    different outcome. A partial marker from a CRASHED child (rc != 0, no
+    timeout) is banked but the child is retried; the richest snapshot seen
+    wins if no attempt completes."""
     attempts = len(_RETRY_BACKOFF_S) + 1
+    best = None
     for i in range(attempts):
         t0 = time.perf_counter()
         proc = subprocess.Popen(
@@ -757,32 +784,48 @@ def _run_tpu_child():
             except subprocess.TimeoutExpired:
                 proc.kill()
                 out, _ = proc.communicate()
-        # scan even a timed-out child's output: the benches may have all
-        # completed (marker printed) before the wedge hit in teardown
+        # take the LAST marker line: the child checkpoints partial results
+        # after every sub-bench, so an interrupted run only costs the tail
+        found = None
         for line in (out or "").splitlines():
             if line.startswith(_CHILD_MARKER):
                 try:
-                    return json.loads(line[len(_CHILD_MARKER):])
+                    found = json.loads(line[len(_CHILD_MARKER):])
                 except ValueError:  # marker line truncated by the kill
-                    print("parent: child results line corrupt; treating as missing", file=sys.stderr)
+                    print(
+                        "parent: ignoring a corrupt (truncated) child results line",
+                        file=sys.stderr,
+                    )
+        if found is not None and not found.get("partial"):
+            return found
+        if found is not None:
+            best = found if best is None or _richness(found) > _richness(best) else best
         if timed_out:
-            # init succeeded but the run wedged — a retry would burn another
-            # _CHILD_TIMEOUT_S with little chance of a different outcome
             print(
                 f"parent: tpu child attempt {i + 1}/{attempts} timed out after {_CHILD_TIMEOUT_S}s "
                 "(wedged mid-bench); not retrying",
                 file=sys.stderr,
             )
-            return None
+            if best is not None:
+                best.setdefault("errors", []).append(
+                    "tpu child wedged mid-bench; reported numbers are the completed prefix"
+                )
+            return best
         print(
             f"parent: tpu child attempt {i + 1}/{attempts} exited rc={proc.returncode} "
-            f"after {time.perf_counter() - t0:.0f}s without results",
+            f"after {time.perf_counter() - t0:.0f}s "
+            f"{'with partial results only' if found is not None else 'without results'}",
             file=sys.stderr,
         )
         if i < attempts - 1:
             print(f"parent: backing off {_RETRY_BACKOFF_S[i]}s before retry", file=sys.stderr, flush=True)
             time.sleep(_RETRY_BACKOFF_S[i])
-    return None
+    if best is not None:
+        best.setdefault("errors", []).append(
+            "tpu child crashed mid-bench on every attempt; reported numbers are "
+            "the richest completed prefix"
+        )
+    return best
 
 
 def _rnd(x, digits):
