@@ -15,7 +15,7 @@ from .pipeline import TrainingPipeline
 from .stage import Stage, TrainValStage
 from .train_state import TrainState
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "data",
