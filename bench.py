@@ -567,6 +567,125 @@ def bench_metrics_allreduce(n_procs=8, epochs=40):
         return p50, ref_p50
 
 
+#: Marker line of the --overlap-child results (CPU-only, tunnel-independent).
+_OVERLAP_MARKER = "OVERLAP_BENCH_RESULTS "
+
+
+def _overlap_config(engine_on: bool, steps: int, batch: int, ckpt_root: str) -> dict:
+    """Two epochs of a small MLP regression through TrainingPipeline with the
+    overlap engine fully on or fully off (async checkpoints + deferred
+    metrics + double-buffered prefetch vs sync + eager + unbuffered), with
+    mid-epoch step saves exercising the checkpoint path. Epoch 1 absorbs
+    compile; the reported steps/sec and host-stall fraction come from epoch
+    2's tracker metrics (misc/train_step_avg_ms, misc/host_stall_ms)."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(steps, batch, 64).astype(np.float32)
+    w_true = rng.randn(64, 1).astype(np.float32)
+    batches = [{"x": x, "y": x @ w_true} for x in xs]
+
+    class OverlapStage(dml.TrainValStage):
+        def pre_stage(self):
+            import flax.linen as nn
+
+            class MLP(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return nn.Dense(1)(jax.nn.relu(nn.Dense(256)(x)))
+
+            model = MLP()
+            self.pipeline.register_model(
+                "mlp", model, params=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64))),
+                verbose=False,
+            )
+            self.pipeline.register_optimizer("sgd", optax.sgd(0.01))
+            self.pipeline.register_dataset("train", batches, verbose=False)
+
+        def step(self, state, batch):
+            pred = state.apply_fn({"params": state.params}, batch["x"])
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        def val_epoch(self):  # train-only measurement
+            pass
+
+        # the three overlap-engine flags, flipped together
+        def async_checkpoint(self):
+            return engine_on
+
+        def deferred_metrics(self):
+            return engine_on
+
+        def prefetch_depth(self):
+            return 2 if engine_on else 0
+
+        def checkpoint_every(self):
+            return 0  # step saves only — epoch saves land outside the timed window
+
+        def checkpoint_every_steps(self):
+            return max(steps // 4, 1)
+
+        def log_every(self):
+            return 25
+
+    pipeline = dml.TrainingPipeline(name=f"bench-overlap-{'on' if engine_on else 'off'}")
+    pipeline.append_stage(OverlapStage(), max_epochs=2)
+    pipeline.enable_checkpointing(ckpt_root)
+    pipeline.run()
+    tracker = pipeline.tracker
+    step_ms = float(tracker["misc/train_step_avg_ms"][-1])
+    stall_ms = float(tracker["misc/host_stall_ms"][-1])
+    epoch_ms = float(tracker["misc/epoch_time"][-1]) * 1e3
+    pipeline.checkpoint_dir.close()
+    return {
+        "steps_per_sec": round(1e3 / step_ms, 2),
+        "host_stall_ms_per_epoch": round(stall_ms, 2),
+        "host_stall_frac": round(stall_ms / max(epoch_ms, 1e-9), 4),
+    }
+
+
+def overlap_child_main():
+    """Runs in a fresh CPU-pinned process: the overlap engine A/B on the
+    same workload, printed behind one marker line."""
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    smoke = bool(os.environ.get("DML_BENCH_SMOKE"))
+    steps, batch = (60, 16) if smoke else (240, 64)
+    out = {"steps": steps, "batch": batch}
+    with tempfile.TemporaryDirectory() as td:
+        # engine OFF first so any in-process jit warm-up bias favors OFF,
+        # making an ON win conservative rather than an artifact
+        out["off"] = _overlap_config(False, steps, batch, os.path.join(td, "off"))
+        out["on"] = _overlap_config(True, steps, batch, os.path.join(td, "on"))
+    on, off = out["on"], out["off"]
+    out["steps_per_sec_ratio_on_vs_off"] = round(on["steps_per_sec"] / off["steps_per_sec"], 4)
+    print(_OVERLAP_MARKER + json.dumps(out), flush=True)
+
+
+def bench_overlap(timeout_s: int = 900) -> dict | None:
+    """Launch the overlap A/B in a CPU-pinned child (it must not touch the
+    TPU tunnel) and return its results dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--overlap-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_OVERLAP_MARKER):
+            try:
+                return json.loads(line[len(_OVERLAP_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 def _init_watchdog(timeout_s: int = None):
     """Fail fast when backend init hangs (wedged device tunnel): a daemon
     thread hard-exits with a clear stderr message unless the returned event
@@ -732,13 +851,19 @@ def child_main():
         # the ratio is batch-for-batch (read lazily: lm has run by then)
         return dict(b=(results.get("lm") or {}).get("batch_size") or 8, vocab_chunk=4096)
 
+    def chunked():
+        # record the ACTUAL vocab_chunk used (128 in smoke mode, 4096 full)
+        # so the result key never claims a chunk size that did not run
+        kw = chunked_kw()
+        return {"tps": bench_lm(**kw)[0], "vocab_chunk": kw["vocab_chunk"]}
+
     plan = [
         ("resnet", resnet),
         ("flash", lambda: list(bench_flash(**flash_kw))),
         ("lm", lm),
         ("decode", lambda: list(bench_decode(**decode_kw))),
         ("speculative", lambda: list(bench_speculative(**spec_kw))),
-        ("chunked_lm", lambda: bench_lm(**chunked_kw())[0]),
+        ("chunked_lm", chunked),
         ("lm_scale", lambda: bench_lm_scale(**scale_kw)),
     ]
     for name, fn in plan:
@@ -843,6 +968,11 @@ def main():
     except Exception as e:  # noqa: BLE001
         print(f"parent: metrics-allreduce bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         metrics_p50 = metrics_ref_p50 = None
+    try:
+        overlap = bench_overlap()
+    except Exception as e:  # noqa: BLE001
+        print(f"parent: overlap bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        overlap = None
     tpu = _run_tpu_child() or {}
 
     peak = tpu.get("peak_flops") or 197e12
@@ -853,19 +983,14 @@ def main():
     decode = tpu.get("decode") or [None, None]
     lm = tpu.get("lm") or {}
     spec = tpu.get("speculative") or [None] * 6
-    chunked_tps = tpu.get("chunked_lm")
+    chunked = tpu.get("chunked_lm")
+    if isinstance(chunked, (int, float)):  # pre-fix child snapshot shape
+        chunked = {"tps": chunked, "vocab_chunk": 4096}
+    chunked = chunked or {}
+    chunked_tps = chunked.get("tps")
     lm_scale = tpu.get("lm_scale") or {}
     value = fw_ips if fw_ips is not None else raw_ips
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_images_per_sec_per_chip",
-                "value": _rnd(value, 2),
-                "unit": "images/s",
-                "vs_baseline": _rnd(
-                    fw_ips / raw_ips if fw_ips is not None and raw_ips is not None else None, 4
-                ),
-                "extras": {
+    extras = {
                     "value_source": ("framework" if fw_ips is not None else "raw" if raw_ips is not None else None),
                     "raw_images_per_sec": _rnd(raw_ips, 2),
                     "batch_size": resnet.get("best_batch"),
@@ -900,10 +1025,6 @@ def main():
                     # with both losses near the corpus's ~0.9-nat floor
                     "spec_decode_train_loss_target": _rnd(spec[4], 3),
                     "spec_decode_train_loss_draft": _rnd(spec[5], 3),
-                    "lm_train_tokens_per_sec_chunked_loss_c4096": _rnd(chunked_tps, 1),
-                    "chunked_loss_ratio_vs_full": _rnd(
-                        chunked_tps / lm["raw_tps"] if chunked_tps and lm.get("raw_tps") else None, 4
-                    ),
                     "lm_train_tokens_per_sec_24l_1024d_s1k": _rnd(lm_scale.get("tps"), 1),
                     "lm_train_mfu_24l_1024d": _rnd(lm_scale.get("mfu"), 4),
                     "lm_train_tokens_per_sec_24l_1024d_s1k_remat": _rnd(lm_scale.get("tps_remat"), 1),
@@ -923,7 +1044,42 @@ def main():
                     ),
                     "device_kind": tpu.get("device_kind"),
                     "bench_errors": tpu.get("errors") or (["tpu child never returned results"] if not tpu else []),
-                },
+    }
+    # key named after the vocab_chunk that ACTUALLY ran (4096 full, 128 smoke)
+    if chunked.get("vocab_chunk") is not None:
+        extras[f"lm_train_tokens_per_sec_chunked_loss_c{chunked['vocab_chunk']}"] = _rnd(chunked_tps, 1)
+        extras["chunked_loss_vocab_chunk"] = chunked["vocab_chunk"]
+    extras["chunked_loss_ratio_vs_full"] = _rnd(
+        chunked_tps / lm["raw_tps"] if chunked_tps and lm.get("raw_tps") else None, 4
+    )
+    if overlap is not None:
+        on, off = overlap.get("on") or {}, overlap.get("off") or {}
+        extras.update(
+            {
+                "overlap_engine_steps_per_sec_on": on.get("steps_per_sec"),
+                "overlap_engine_steps_per_sec_off": off.get("steps_per_sec"),
+                "overlap_engine_speedup_on_vs_off": overlap.get("steps_per_sec_ratio_on_vs_off"),
+                "overlap_engine_host_stall_frac_on": on.get("host_stall_frac"),
+                "overlap_engine_host_stall_frac_off": off.get("host_stall_frac"),
+                "overlap_engine_host_stall_ms_on": on.get("host_stall_ms_per_epoch"),
+                "overlap_engine_host_stall_ms_off": off.get("host_stall_ms_per_epoch"),
+                "overlap_engine_env": (
+                    f"CPU child process, MLP {overlap.get('steps')} steps x batch "
+                    f"{overlap.get('batch')}, mid-epoch step saves; "
+                    "async_checkpoint+deferred_metrics+prefetch_depth=2 vs all off"
+                ),
+            }
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": _rnd(value, 2),
+                "unit": "images/s",
+                "vs_baseline": _rnd(
+                    fw_ips / raw_ips if fw_ips is not None and raw_ips is not None else None, 4
+                ),
+                "extras": extras,
             }
         )
     )
@@ -932,5 +1088,7 @@ def main():
 if __name__ == "__main__":
     if "--tpu-child" in sys.argv[1:]:
         child_main()
+    elif "--overlap-child" in sys.argv[1:]:
+        overlap_child_main()
     else:
         main()

@@ -12,7 +12,7 @@ from . import data, metrics, parallel, utils
 from .checkpoint import CheckpointDir, find_slurm_checkpoint, generate_checkpoint_path
 from .metrics import MetricReducer, MetricTracker, Reduction
 from .pipeline import TrainingPipeline
-from .stage import Stage, TrainValStage
+from .stage import DatasetNotFoundError, Stage, TrainValStage
 from .train_state import TrainState
 
 __version__ = "0.5.0"
@@ -29,6 +29,7 @@ __all__ = [
     "MetricTracker",
     "Reduction",
     "TrainingPipeline",
+    "DatasetNotFoundError",
     "Stage",
     "TrainValStage",
     "TrainState",

@@ -284,8 +284,18 @@ class CheckpointDir:
     def latest_step(self, scope: str | None = None) -> int | None:
         return self.state_manager(scope).latest_step()
 
-    def wait_until_finished(self) -> None:
-        """Block until pending async saves commit."""
+    _ALL_SCOPES = object()  # sentinel: scope=None names a real scope
+
+    def wait_until_finished(self, scope: Any = _ALL_SCOPES) -> None:
+        """Block until pending async saves commit — for one ``scope``, or for
+        every manager (the default). The overlap engine's sync points
+        (pre-save single-flight wait, stage end, run end, preemption exit)
+        all land here; a scope with no manager yet is a no-op."""
+        if scope is not CheckpointDir._ALL_SCOPES:
+            mgr = self._state_managers.get(scope)
+            if mgr is not None:
+                mgr.wait_until_finished()
+            return
         for mgr in self._state_managers.values():
             mgr.wait_until_finished()
 

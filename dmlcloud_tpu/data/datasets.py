@@ -253,13 +253,18 @@ class DataPipeline(_DatasetBase):
         host IO off the training thread's critical path."""
         return self._chain(lambda it, _e: _prefetch_iter(it, num_elements), self._length_fn)
 
-    def to_device(self, mesh, pspec=None, prefetch: int = 2) -> "DataPipeline":
+    def to_device(self, mesh, pspec=None, prefetch: int = 2, host_prefetch: int = 0) -> "DataPipeline":
         """End the pipeline on-device: batches become mesh-sharded global
-        jax.Arrays with ``prefetch`` transfers in flight ahead of the step."""
+        jax.Arrays with ``prefetch`` transfers in flight ahead of the step;
+        ``host_prefetch > 0`` additionally prepares that many host batches
+        ahead on a background thread (device.py)."""
         from .device import device_iterator
 
         return self._chain(
-            lambda it, _e: device_iterator(it, mesh, pspec=pspec, prefetch=prefetch), self._length_fn
+            lambda it, _e: device_iterator(
+                it, mesh, pspec=pspec, prefetch=prefetch, host_prefetch=host_prefetch
+            ),
+            self._length_fn,
         )
 
 

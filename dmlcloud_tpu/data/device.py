@@ -5,6 +5,17 @@ transfer schedule matters: overlapping the next batch's host->HBM copy with
 the current step hides DCN/PCIe latency entirely. ``device_iterator`` wraps
 any host-batch iterator into a pipeline that keeps ``prefetch`` batches
 resident on device, already laid out with the mesh's batch sharding.
+
+Two overlap stages, both optional:
+
+1. **Device prefetch** (``prefetch``, default 2): ``jax.device_put`` is
+   async — the H2D copy of batch N+1/N+2 runs while the device computes on
+   batch N, so a depth of 2 (double buffering) hides the transfer entirely.
+2. **Host prefetch** (``host_prefetch``, default 0): drain the *source*
+   iterator on a background thread (bounded queue), so host-side batch prep
+   (augmentation, numpy collation, disk reads) overlaps the training
+   thread's dispatch work too. JAX calls (``make_global_batch``) stay on the
+   consuming thread — only pure host work moves off it.
 """
 
 from __future__ import annotations
@@ -22,15 +33,22 @@ def device_iterator(
     mesh: Mesh,
     pspec: P | None = None,
     prefetch: int = 2,
+    host_prefetch: int = 0,
 ) -> Iterator[Any]:
     """Yield device-resident, mesh-sharded batches, keeping ``prefetch``
-    transfers in flight ahead of consumption.
+    transfers in flight ahead of consumption (and, with ``host_prefetch > 0``,
+    that many host batches prepared ahead on a background thread).
 
     jax transfers are async: ``device_put`` returns immediately and the copy
     overlaps compute, so a small ``prefetch`` suffices to fully hide it.
     """
     queue: collections.deque = collections.deque()
-    src = iter(it)
+    if host_prefetch > 0:
+        from .datasets import _prefetch_iter
+
+        src = _prefetch_iter(iter(it), host_prefetch)
+    else:
+        src = iter(it)
 
     def enqueue(n: int) -> None:
         for _ in range(n):

@@ -98,7 +98,17 @@ class MetricReducer:
     def append(self, value: Any) -> None:
         """Append a value. jax.Arrays are kept as-is — NOT synced to host here
         (the device->host copy is batched at epoch end), so this never blocks
-        the async dispatch queue mid-epoch."""
+        the async dispatch queue mid-epoch. A non-blocking D2H copy is
+        *started* immediately though: it rides the dispatch queue behind the
+        step that produces the value, so by reduce time the batched
+        ``device_get`` mostly finds the bytes already on host instead of
+        draining a whole epoch of readbacks at the sync point."""
+        copy_async = getattr(value, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:  # committed/donated edge cases must never break tracking
+                pass
         self.values.append(value)
 
     def extend(self, values: Iterable[Any]) -> None:

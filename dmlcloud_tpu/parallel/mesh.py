@@ -52,12 +52,17 @@ def parse_mesh_axes(spec: str) -> dict[str, int]:
         try:
             if not (name and eq):
                 raise ValueError
-            axes[name] = int(size)
+            parsed = int(size)
         except ValueError:
             raise ValueError(
                 f"malformed mesh spec {spec!r}: expected comma-separated name=int "
                 f"pairs like 'data=2,fsdp=4' (bad part: {part!r})"
             ) from None
+        if name in axes:
+            # a duplicate would silently drop the first size (dict overwrite)
+            # — e.g. 'data=2,data=4' becoming {'data': 4}
+            raise ValueError(f"malformed mesh spec {spec!r}: axis {name!r} given more than once")
+        axes[name] = parsed
     return axes
 
 

@@ -365,6 +365,11 @@ def _client():
 
 _seq = {"barrier": 0, "obj": 0}
 
+#: Barrier ids whose arrival keys are safe to garbage-collect (the barrier
+#: completed on this rank). Swept by the ROOT rank at the NEXT successful
+#: barrier — see the retention note inside ``barrier()``.
+_gc_barrier_ids: list = []
+
 
 class BarrierTimeout(RuntimeError):
     """A barrier timed out; ``stragglers`` lists the ranks that never arrived
@@ -413,11 +418,14 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
     _seq["barrier"] += 1
     barrier_id = f"dmlcloud_tpu:{tag}:{_seq['barrier']}"
     if client is not None:
-        # Arrival keys are never deleted: a rank that passed the barrier and
-        # retired its key could be misreported as a straggler by a rank whose
-        # timer expired in the same instant the barrier completed. The keys
-        # are a few bytes per (barrier, rank) in the coordinator's RAM for
-        # the life of the job — a fair price for truthful diagnostics.
+        # Arrival-key retention: keys are NOT deleted when their own barrier
+        # completes — a rank whose timer expired in the same instant the
+        # barrier completed could then misreport arrived ranks as
+        # stragglers. Instead the root sweeps them ONE completed barrier
+        # later (below): by the time a subsequent barrier succeeds, every
+        # rank has provably left the earlier one, so its keys can no longer
+        # feed any straggler probe. Bounds the coordinator's KV-store RAM to
+        # O(world) keys instead of O(world x barriers) on month-long jobs.
         client.key_value_set(f"{barrier_id}/arrived/{rank()}", "1")
         try:
             client.wait_at_barrier(barrier_id, timeout_in_ms=int(timeout * 1000))
@@ -426,6 +434,15 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
             if "deadline" in msg or "timeout" in msg or "timed out" in msg:
                 raise BarrierTimeout(tag, timeout, _find_stragglers(client, barrier_id)) from e
             raise  # not a timeout (e.g. coordinator connection lost) — do not misdiagnose
+        if is_root():
+            for done_id in _gc_barrier_ids:
+                for src in range(world_size()):
+                    try:
+                        client.key_value_delete(f"{done_id}/arrived/{src}")
+                    except Exception:  # best effort — a missing delete is only RAM
+                        pass
+        _gc_barrier_ids.clear()
+        _gc_barrier_ids.append(barrier_id)
     else:  # pragma: no cover - multiprocess without coordination service
         from jax.experimental import multihost_utils
 
@@ -461,8 +478,12 @@ class CollectiveMismatchError(RuntimeError):
 
 
 def _call_site_tag() -> str:
-    """``file.py:lineno`` of the first frame outside this module — the user
-    call site, fingerprinting WHICH collective call this is."""
+    """``dir/file.py:lineno`` of the first frame outside this module — the
+    user call site, fingerprinting WHICH collective call this is. The last
+    TWO path components are kept: a bare basename collides across packages
+    (every project has a ``train.py``/``utils.py``), which would pair two
+    genuinely different call sites as "matching" and let a diverged
+    collective sequence deliver the wrong object undiagnosed."""
     import sys
 
     f = sys._getframe(1)
@@ -470,7 +491,8 @@ def _call_site_tag() -> str:
         f = f.f_back
     if f is None:  # pragma: no cover - interpreter entry
         return "?"
-    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+    parts = f.f_code.co_filename.replace(os.sep, "/").rsplit("/", 2)
+    return f"{'/'.join(parts[-2:])}:{f.f_lineno}"
 
 
 def _put_obj(key: str, obj: Any, tag: str) -> None:

@@ -225,11 +225,31 @@ class TrainingPipeline:
         raise ValueError("Multiple models registered; override Stage.model_name() to pick one.")
 
     def _optimizer_for(self, model_name: str):
-        for opt_name, opt in self.optimizers.items():
-            bound = self._optimizer_model.get(opt_name)
-            if bound == model_name or bound is None:
-                return opt
-        raise ValueError("No optimizer registered. Call register_optimizer() (e.g. in pre_stage).")
+        if not self.optimizers:
+            raise ValueError("No optimizer registered. Call register_optimizer() (e.g. in pre_stage).")
+        explicit = [n for n, m in self._optimizer_model.items() if m == model_name]
+        if len(explicit) > 1:
+            raise ValueError(
+                f"Multiple optimizers ({explicit}) registered for model {model_name!r}; "
+                "a model can only be trained by one optimizer per stage."
+            )
+        if explicit:
+            return self.optimizers[explicit[0]]
+        unbound = [n for n, m in self._optimizer_model.items() if m is None]
+        # mirror _model_entry's ambiguity error: with several models AND
+        # several unbound optimizers there is no defensible pairing — the old
+        # behavior silently trained every model with the first optimizer
+        if len(unbound) > 1 and len(self.models) > 1:
+            raise ValueError(
+                f"Multiple unbound optimizers ({unbound}) and multiple models registered; "
+                "pass model=... to register_optimizer() to bind each optimizer to its model."
+            )
+        if unbound:
+            return self.optimizers[unbound[0]]
+        raise ValueError(
+            f"No optimizer registered for model {model_name!r} and no unbound optimizer "
+            "to fall back on. Call register_optimizer(model=...)."
+        )
 
     # -------------------------------------------------------- checkpointing
     def enable_checkpointing(self, root: str, resume: bool = False):
@@ -497,6 +517,15 @@ class TrainingPipeline:
             self.logger.info("=== run aborted by user (KeyboardInterrupt) ===")
         elif exc is not None:
             self.logger.error("=== run failed; traceback follows ===", exc_info=exc)
+        if self.checkpoint_dir is not None:
+            # a failed/interrupted run may still have an async save in
+            # flight: let it commit (or surface its own error to the log)
+            # rather than orphan a half-written checkpoint behind the
+            # exception that is about to propagate
+            try:
+                self.checkpoint_dir.wait_until_finished()
+            except Exception:
+                self.logger.warning("pending async checkpoint save failed during teardown", exc_info=True)
         if self.wandb and wandb_is_initialized():
             wandb.finish(exit_code=0 if exc is None else 1)
         if self._tb_writer is not None:

@@ -20,6 +20,24 @@ with the hot loop re-designed for XLA:
   ``misc/step_dispatch_ms`` is host dispatch-to-dispatch time, and
   ``misc/train_step_avg_ms`` is the wall-clock per-step average taken after
   a single ``block_until_ready`` closes the pipeline at epoch end.
+
+The **overlap engine** (doc/performance.md §"Overlap engine") removes the
+remaining host-induced stalls, each behind a flag so behavior can be
+bisected:
+
+- ``async_checkpoint()`` (default True): Orbax saves commit on a background
+  writer; at most one save is in flight (a new save first waits for the
+  previous), with hard barriers at stage end, run end, and preemption exit.
+- ``prefetch_depth()`` (default 2, the old ``device_prefetch``) +
+  ``host_prefetch()``: double-buffered H2D transfer, optionally with host
+  batch prep on a background thread (data/device.py).
+- ``deferred_metrics()`` (default True): nothing inside the step loop reads
+  a device value; host syncs happen only at ``log_every()`` boundaries
+  (where the NaN/inf guard piggybacks on a 2-step-trailing loss fetch) and
+  at the epoch-end fused exchange. ``deferred_metrics() == False`` restores
+  the eager per-step readback for A/B bisection.
+- Every host block is accounted: ``misc/host_stall_ms`` is the wall-clock
+  the loop spent waiting on the device or on checkpoint commits this epoch.
 """
 
 from __future__ import annotations
@@ -40,9 +58,17 @@ from .parallel import runtime
 from .parallel.runtime import is_root
 from .train_state import TrainState
 from .utils.logging import DevNullIO, flush_log_handlers
+from .utils.profiling import StallTimer
 from .utils.table import ProgressTable
 
-__all__ = ["Stage", "TrainValStage"]
+__all__ = ["Stage", "TrainValStage", "DatasetNotFoundError"]
+
+
+class DatasetNotFoundError(ValueError):
+    """A stage asked the pipeline registry for a dataset that was never
+    registered. ``val_epoch`` treats exactly this as "validation is optional"
+    — a plain ``ValueError`` raised by a user ``val_dataset()`` override is a
+    bug and propagates."""
 
 
 class Stage:
@@ -284,12 +310,20 @@ class TrainValStage(Stage):
         #: short: run_epoch skips val and Stage.run exits without treating
         #: the partial epoch as complete
         self._mid_epoch_exit = False
+        #: accumulates the wall-clock the host spends blocked on the device
+        #: or on checkpoint commits; reset per epoch, published as
+        #: ``misc/host_stall_ms``
+        self._stall = StallTimer()
+        #: True exactly while the per-batch body of train_epoch runs — the
+        #: window in which NO device readback may happen under
+        #: ``deferred_metrics()`` (tests assert against it)
+        self._in_step_loop = False
 
     # -- overridables (parity: reference stage.py:228-257) ------------------
     def train_dataset(self):
         ds = self.pipeline.datasets.get("train")
         if ds is None:
-            raise ValueError(
+            raise DatasetNotFoundError(
                 'No "train" dataset found in pipeline. Use register_dataset("train", ...) to register a dataset.'
             )
         return ds
@@ -297,7 +331,7 @@ class TrainValStage(Stage):
     def val_dataset(self):
         ds = self.pipeline.datasets.get("val")
         if ds is None:
-            raise ValueError(
+            raise DatasetNotFoundError(
                 'No "val" dataset found in pipeline. Use register_dataset("val", ...) to register a dataset.'
             )
         return ds
@@ -378,6 +412,57 @@ class TrainValStage(Stage):
         compute). Return 0 to feed synchronously (one ``make_global_batch``
         per step) — e.g. when batches are huge and HBM is tight."""
         return 2
+
+    def prefetch_depth(self) -> int:
+        """The overlap engine's canonical name for the device prefetch depth
+        (default: whatever ``device_prefetch()`` says, so existing overrides
+        keep working). 2 = double buffering — batch N+1's H2D copy runs
+        while the device computes batch N; 0 = synchronous per-step puts."""
+        return int(self.device_prefetch())
+
+    def host_prefetch(self) -> int:
+        """Host batches prepared ahead on a background thread before the
+        device transfer queue (data/device.py). 0 (default) keeps host batch
+        prep on the training thread — raise it when prep (augmentation,
+        decode, disk reads) is a measurable share of the step budget."""
+        return 0
+
+    def async_checkpoint(self) -> bool:
+        """Whether this stage's Orbax scopes commit saves on a background
+        writer (non-blocking saves; default True). The loop never has more
+        than one save in flight — a new save first waits out the previous —
+        and hard barriers at stage end / run end / preemption exit guarantee
+        everything is committed before the process goes away, so resume
+        semantics are identical to synchronous saves: a checkpoint either
+        committed completely or does not exist. False restores fully
+        synchronous saves (the bisection baseline)."""
+        return True
+
+    def deferred_metrics(self) -> bool:
+        """Whether per-step metrics stay on device until a sync point
+        (default True): no ``.item()``/``device_get`` runs inside the step
+        loop; host syncs happen only every ``log_every()`` steps (a 2-step-
+        trailing loss fetch that also feeds the NaN/inf guard and the live
+        table) and at the epoch-end fused exchange. False restores the
+        eager path — every step's metrics are fetched to host immediately —
+        which produces identical epoch-end values, just slower."""
+        return True
+
+    def log_every(self) -> int:
+        """Steps between host syncs inside the training loop when
+        ``deferred_metrics()`` is on: each boundary fetches one trailing
+        loss value (already computed — minimal stall), updates the live
+        console EMA, and runs the NaN/inf guard. 0 disables the periodic
+        sync entirely (the guard then only sees the epoch-end values)."""
+        return 50
+
+    def nan_guard(self) -> bool:
+        """Whether the periodic ``log_every()`` sync raises
+        ``FloatingPointError`` on a non-finite loss (default True). Under
+        deferred metrics the check piggybacks on the boundary fetch —
+        detection trails the bad step by up to ``log_every()`` steps instead
+        of paying a per-step sync; with eager metrics it checks every step."""
+        return True
 
     def checkpoint_every(self) -> int:
         """Epochs between automatic TrainState saves (0 disables). Active
@@ -595,12 +680,13 @@ class TrainValStage(Stage):
         ckpt = self.pipeline.checkpoint_dir
         if ckpt is None:
             return
+        asave = bool(self.async_checkpoint())
         # step-save scope first: it must get its newest-only retention even
         # when the user pre-configured the EPOCH scope (early return below)
         # or disabled epoch checkpointing outright
         if int(self.checkpoint_every_steps()) > 0 and not ckpt.has_state_manager(self._steps_scope):
             # crash/preemption insurance only — history lives in epoch saves
-            ckpt.state_manager(self._steps_scope, max_to_keep=1)
+            ckpt.state_manager(self._steps_scope, max_to_keep=1, async_save=asave)
         if int(self.checkpoint_every()) <= 0:
             return
         if ckpt.has_state_manager(self.name):
@@ -632,7 +718,7 @@ class TrainValStage(Stage):
                 )
             }
         keep = None if opts else int(self.checkpoint_keep())  # policy owns retention when set
-        ckpt.state_manager(self.name, max_to_keep=keep, **opts)
+        ckpt.state_manager(self.name, max_to_keep=keep, async_save=asave, **opts)
 
     @property
     def _steps_scope(self) -> str:
@@ -655,11 +741,29 @@ class TrainValStage(Stage):
         self._train_step_fn = self._build_train_step()
         self._val_step_fn = self._build_val_step()
 
+    def _pre_epoch(self):
+        self._stall.reset()  # misc/host_stall_ms is a per-epoch total
+        super()._pre_epoch()
+
+    def _reduce_metrics(self):
+        # everything the host spent blocked this epoch (value fetches, the
+        # epoch-end block_until_ready, waits on async checkpoint commits)
+        self.track("misc/host_stall_ms", round(self._stall.ms, 3), prefixed=False)
+        super()._reduce_metrics()
+
     def _post_epoch(self):
         super()._post_epoch()
         self._maybe_save_state()
 
     def _post_stage(self):
+        # sync point: every async save this stage dispatched must be
+        # committed before the stage is considered finished — a following
+        # stage's restore, the run-end teardown, and a preemption exit
+        # (mid-epoch or epoch-boundary, both route through here) all rely
+        # on the newest checkpoint being durable at this line
+        if self.pipeline.checkpoint_dir is not None:
+            self.pipeline.checkpoint_dir.wait_until_finished(scope=self.name)
+            self.pipeline.checkpoint_dir.wait_until_finished(scope=self._steps_scope)
         # publish trained params back to the registry so a following stage
         # continues from them (the reference's in-place nn.Module semantics)
         if self.state is not None:
@@ -706,7 +810,13 @@ class TrainValStage(Stage):
                 )
             else:
                 save_kwargs["metrics"] = {best_metric: float(val)}
-        ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
+        # single-flight: an async save still committing from a previous epoch
+        # is waited out (timed as stall) before the new one dispatches. The
+        # save call itself is timed too — async it costs one D2H snapshot,
+        # sync (async_checkpoint() False) it blocks for the full commit.
+        with self._stall.measure():
+            ckpt.wait_until_finished(scope=self.name)
+            ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
         if is_root():
             from .utils.serialization import to_jsonable
 
@@ -757,8 +867,14 @@ class TrainValStage(Stage):
         a root-written sidecar recording where inside which epoch it landed
         (what a resume needs to fast-forward the data)."""
         ckpt = self.pipeline.checkpoint_dir
-        gstep = int(jax.device_get(self.state.step))
-        ckpt.save_state(gstep, self._state_pytree(), scope=self._steps_scope)
+        with self._stall.measure():
+            # at most one save in flight; the step-counter fetch blocks on
+            # the dispatched steps, so both waits count as host stall — as
+            # does the save call itself (one D2H snapshot when async, the
+            # full blocking commit when async_checkpoint() is off)
+            ckpt.wait_until_finished(scope=self._steps_scope)
+            gstep = int(jax.device_get(self.state.step))
+            ckpt.save_state(gstep, self._state_pytree(), scope=self._steps_scope)
         if is_root():
             self._write_resume_sidecar(
                 self._steps_scope,
@@ -953,13 +1069,16 @@ class TrainValStage(Stage):
 
     def _feed(self, ds):
         """The device feeding path: mesh-sharded batches with
-        ``device_prefetch()`` transfers in flight ahead of the step
-        (data/device.py), or per-step synchronous puts when disabled."""
-        prefetch = int(self.device_prefetch())
+        ``prefetch_depth()`` transfers in flight ahead of the step — and
+        optionally ``host_prefetch()`` host batches prepared on a background
+        thread (data/device.py) — or per-step synchronous puts when disabled."""
+        prefetch = int(self.prefetch_depth())
         if prefetch > 0:
             from .data.device import device_iterator
 
-            return device_iterator(ds, self.mesh, prefetch=prefetch)
+            return device_iterator(
+                ds, self.mesh, prefetch=prefetch, host_prefetch=int(self.host_prefetch())
+            )
         return (self._put(batch) for batch in ds)
 
     def train_epoch(self):
@@ -988,67 +1107,108 @@ class TrainValStage(Stage):
         if self.pipeline.checkpoint_dir is None:
             every_steps = 0
 
-        # Live console row (reference stage.py:188-205 UX): loss EMA and
-        # steps/s update in place during the epoch. The EMA fetch trails the
-        # dispatch by 2 steps so it reads an already-computed value instead
-        # of stalling the async pipeline; everything is skipped entirely
-        # when no live console exists (non-root, log files, CI, benches).
+        # Deferred-readback plumbing (overlap engine). Deferred (default):
+        # losses ride a short rolling window of device arrays whose D2H
+        # copies are issued non-blocking at dispatch time; the host touches
+        # a value only every log_every() steps — a 2-3-step-TRAILING fetch
+        # that is already computed AND already copied, so the sync point
+        # costs ~nothing. The NaN/inf guard and the live console EMA both
+        # piggyback on that one periodic fetch. Eager (deferred_metrics()
+        # False, the bisection baseline): every step's metrics are pulled to
+        # host immediately, timed as stall.
         live = self.table.live_target() is not None
+        deferred = bool(self.deferred_metrics())
+        log_every = int(self.log_every())
+        guard = bool(self.nan_guard())
+        loss_name = self.loss_metric_name()
         pending_losses: list = []
         loss_ema = None
         steps_done = 0
         epoch_t0 = time.perf_counter()
         last_render = 0.0
 
+        def _guard_loss(v: float, at_step: int) -> None:
+            if guard and not np.isfinite(v):
+                raise FloatingPointError(
+                    f"non-finite loss ({v}) detected at step {at_step} of epoch "
+                    f"{self.current_epoch} (stage {self.name!r})"
+                )
+
         last_metrics = None
-        for batch in self._feed(train_ds):
-            step_start = time.perf_counter_ns()
-            self.state, metrics = self._train_step_fn(self.state, batch)
-            step_end = time.perf_counter_ns()
+        self._in_step_loop = True
+        try:
+            for batch in self._feed(train_ds):
+                step_start = time.perf_counter_ns()
+                self.state, metrics = self._train_step_fn(self.state, batch)
+                step_end = time.perf_counter_ns()
 
-            for mname, mval in metrics.items():
-                self.track_reduce(mname, mval)
-            self.track_reduce("misc/total_train_batches", 1, reduction=Reduction.SUM, prefixed=False)
-            self.track_reduce(
-                "misc/worker_train_batches", 1, reduction=Reduction.SUM, reduce_globally=False, prefixed=False
-            )
-            # dispatch-to-dispatch time: how long the host took to enqueue the
-            # step. Under async dispatch this is NOT device execution time —
-            # see misc/train_step_avg_ms for the wall-clock per-step average.
-            self.track_reduce("misc/step_dispatch_ms", (step_end - step_start) / 1e6, prefixed=False)
-            last_metrics = metrics
+                if not deferred:
+                    with self._stall.measure():  # eager path: per-step readback
+                        metrics = jax.device_get(metrics)
+                for mname, mval in metrics.items():
+                    self.track_reduce(mname, mval)
+                self.track_reduce("misc/total_train_batches", 1, reduction=Reduction.SUM, prefixed=False)
+                self.track_reduce(
+                    "misc/worker_train_batches", 1, reduction=Reduction.SUM, reduce_globally=False, prefixed=False
+                )
+                # dispatch-to-dispatch time: how long the host took to enqueue the
+                # step. Under async dispatch this is NOT device execution time —
+                # see misc/train_step_avg_ms for the wall-clock per-step average.
+                self.track_reduce("misc/step_dispatch_ms", (step_end - step_start) / 1e6, prefixed=False)
+                last_metrics = metrics
 
-            steps_done += 1
-            if every_steps and (skipped + steps_done) % every_steps == 0:
-                self._save_step_state(skipped + steps_done)
-                if self.pipeline._preemption_coordinated():
-                    # the save just above is the resume point; cut the epoch
-                    # here instead of finishing it (Stage.run handles exit)
-                    self._mid_epoch_exit = True
-                    break
-            if live:
-                pending_losses.append(metrics.get(self.loss_metric_name()))
-                if len(pending_losses) > 2:
-                    val = pending_losses.pop(0)
-                    if val is not None:
-                        val = float(jax.device_get(val))
-                        loss_ema = val if loss_ema is None else 0.98 * loss_ema + 0.02 * val
-                now = time.perf_counter()
-                if now - last_render > 0.25:
-                    self.table.live(
-                        {
-                            "Epoch": self.current_epoch,
-                            "[Train] Loss": loss_ema,
-                            "it/s": steps_done / max(now - epoch_t0, 1e-9),
-                        }
-                    )
-                    last_render = now
+                steps_done += 1
+                if every_steps and (skipped + steps_done) % every_steps == 0:
+                    self._save_step_state(skipped + steps_done)
+                    if self.pipeline._preemption_coordinated():
+                        # the save just above is the resume point; cut the epoch
+                        # here instead of finishing it (Stage.run handles exit)
+                        self._mid_epoch_exit = True
+                        break
+
+                loss_val = metrics.get(loss_name)
+                if deferred:
+                    if loss_val is not None and (live or (guard and log_every > 0)):
+                        copy_async = getattr(loss_val, "copy_to_host_async", None)
+                        if copy_async is not None:
+                            try:
+                                copy_async()
+                            except Exception:
+                                pass
+                        pending_losses.append(loss_val)
+                        if len(pending_losses) > 3:
+                            pending_losses.pop(0)
+                    if log_every > 0 and steps_done % log_every == 0 and pending_losses:
+                        v = float(self._stall.fetch(pending_losses[0]))
+                        loss_ema = v if loss_ema is None else 0.98 * loss_ema + 0.02 * v
+                        _guard_loss(v, steps_done)
+                elif loss_val is not None:
+                    v = float(np.asarray(loss_val))  # already host-side
+                    loss_ema = v if loss_ema is None else 0.98 * loss_ema + 0.02 * v
+                    _guard_loss(v, steps_done)
+
+                if live:
+                    now = time.perf_counter()
+                    if now - last_render > 0.25:
+                        self.table.live(
+                            {
+                                "Epoch": self.current_epoch,
+                                "[Train] Loss": loss_ema,
+                                "it/s": steps_done / max(now - epoch_t0, 1e-9),
+                            }
+                        )
+                        last_render = now
+        finally:
+            self._in_step_loop = False
 
         # Close the async pipeline BEFORE the epoch wall-clock reading so the
         # per-step average below reflects device execution, then derive the
-        # honest number users actually want from "step time".
+        # honest number users actually want from "step time". This is THE
+        # epoch sync point: past this line every dispatched step has
+        # executed and host-side state (tracker buffers, self.state) is
+        # guaranteed current.
         if last_metrics is not None:
-            jax.block_until_ready(last_metrics)
+            self._stall.block(last_metrics)
         if self._mid_epoch_exit:
             # partial epoch: skip epoch-level metrics — the resumed run
             # finishes the epoch and reduces over its remaining steps
@@ -1079,7 +1239,11 @@ class TrainValStage(Stage):
         self.table["it/s"] = steps_done / max(train_elapsed, 1e-9)
 
         for name, schedule in self.pipeline.schedulers.items():
-            step_count = int(jax.device_get(self.state.step)) if self.state is not None else 0
+            if self.state is not None:
+                with self._stall.measure():
+                    step_count = int(jax.device_get(self.state.step))
+            else:
+                step_count = 0
             self.track(f"misc/lr_{name}", float(schedule(step_count)), prefixed=False)
 
     def val_epoch(self):
@@ -1088,12 +1252,20 @@ class TrainValStage(Stage):
 
         try:
             val_ds = self.val_dataset()
-        except ValueError:
-            return  # val dataset optional in the TPU build
+        except DatasetNotFoundError:
+            # val dataset optional in the TPU build. ONLY the sentinel is
+            # swallowed — an arbitrary ValueError raised by a user
+            # val_dataset() override is a bug and must surface, not silently
+            # skip validation forever.
+            return
 
+        deferred = bool(self.deferred_metrics())
         last_metrics = None
         for batch in self._feed(val_ds):
             metrics = self._val_step_fn(self.state, batch)
+            if not deferred:
+                with self._stall.measure():  # eager path: per-step readback
+                    metrics = jax.device_get(metrics)
             for mname, mval in metrics.items():
                 self.track_reduce(mname, mval)
             self.track_reduce("misc/total_val_batches", 1, reduction=Reduction.SUM, prefixed=False)
@@ -1102,7 +1274,7 @@ class TrainValStage(Stage):
             )
             last_metrics = metrics
         if last_metrics is not None:
-            jax.block_until_ready(last_metrics)
+            self._stall.block(last_metrics)
 
     def table_columns(self):
         columns = super().table_columns()

@@ -8,9 +8,13 @@ SURVEY.md §5.1): capture real XLA traces viewable in TensorBoard/Perfetto.
 - ``roofline(trace_dir)``: parse the trace's own per-op hardware counters
   (hlo_category / flops / bytes_accessed) into a per-category roofline
   table next to the chip's peaks — the analysis that settled whether the
-  ResNet bench was MXU- or HBM-bound (doc/performance.md §5).
+  ResNet bench was MXU- or HBM-bound (doc/performance.md §6).
 - ``StepTimer``: dispatch-to-dispatch wall timer with p50/p95 summaries, the
   host-side complement used by bench.py.
+- ``StallTimer``: accumulates the wall-clock the host spends *blocked* on
+  device results or pending checkpoint commits — the overlap engine's
+  ``misc/host_stall_ms`` metric (stage.py) and the host-stall fraction
+  ``bench.py --overlap-child`` reports.
 """
 
 from __future__ import annotations
@@ -23,7 +27,46 @@ from contextlib import contextmanager
 
 import numpy as np
 
-__all__ = ["trace", "profile_steps", "roofline", "format_roofline", "StepTimer"]
+__all__ = ["trace", "profile_steps", "roofline", "format_roofline", "StepTimer", "StallTimer"]
+
+
+class StallTimer:
+    """Accumulates host-stall time: every block the training loop spends
+    waiting on the device (value fetches, ``block_until_ready``, waiting for
+    a previous async checkpoint to commit) runs under ``measure()`` and adds
+    to one counter. The epoch loop resets it per epoch and publishes the
+    total as ``misc/host_stall_ms`` — the number the overlap engine exists
+    to drive toward zero."""
+
+    def __init__(self):
+        self._ns = 0
+
+    @contextmanager
+    def measure(self):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self._ns += time.perf_counter_ns() - t0
+
+    def block(self, tree):
+        """``jax.block_until_ready`` under the timer (the epoch-end sync)."""
+        import jax
+
+        with self.measure():
+            return jax.block_until_ready(tree)
+
+    def fetch(self, value):
+        """Fetch ``value`` to host under the timer, returning a numpy array."""
+        with self.measure():
+            return np.asarray(value)
+
+    @property
+    def ms(self) -> float:
+        return self._ns / 1e6
+
+    def reset(self) -> None:
+        self._ns = 0
 
 
 @contextmanager

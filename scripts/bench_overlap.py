@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Standalone overlap-engine A/B: steps/sec and host-stall fraction with the
+engine on vs off, on whatever backend this process sees (pass
+``JAX_PLATFORMS=cpu`` for the smoke configuration bench.py records).
+
+Thin CLI over ``bench._overlap_config`` so the committed bench numbers and an
+interactive investigation run the exact same workload.
+
+    JAX_PLATFORMS=cpu python scripts/bench_overlap.py --steps 240 --batch 64
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=240, help="train steps per epoch")
+    parser.add_argument("--batch", type=int, default=64, help="batch size")
+    args = parser.parse_args()
+
+    from bench import _overlap_config
+
+    with tempfile.TemporaryDirectory() as td:
+        off = _overlap_config(False, args.steps, args.batch, os.path.join(td, "off"))
+        on = _overlap_config(True, args.steps, args.batch, os.path.join(td, "on"))
+    ratio = round(on["steps_per_sec"] / off["steps_per_sec"], 4)
+    print(json.dumps({"on": on, "off": off, "steps_per_sec_ratio_on_vs_off": ratio}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

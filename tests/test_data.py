@@ -275,6 +275,23 @@ class TestDataPipeline:
         assert isinstance(out[0]["x"], jax.Array)
         assert out[0]["x"].sharding.spec == mesh_lib.batch_pspec(mesh)
 
+    def test_device_iterator_host_prefetch_preserves_order(self, single_runtime):
+        """Background-thread host batch prep must not reorder, drop, or
+        corrupt batches relative to the plain path."""
+        import jax
+
+        from dmlcloud_tpu.data.device import device_iterator
+        from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.create_mesh({"data": 8})
+        batches = [{"x": np.full((16, 1), i, np.float32)} for i in range(6)]
+        plain = list(device_iterator(iter(batches), mesh, prefetch=2))
+        threaded = list(device_iterator(iter(batches), mesh, prefetch=2, host_prefetch=3))
+        assert len(plain) == len(threaded) == 6
+        for a, b in zip(plain, threaded):
+            np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+            assert isinstance(b["x"], jax.Array)
+
     def test_shims_pickle_roundtrip(self, single_runtime):
         """DataLoader workers receive datasets by pickle; the shims must
         survive the round trip with epoch intact."""

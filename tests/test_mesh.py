@@ -105,3 +105,32 @@ def test_grad_mean_matches_single_device(mesh8):
     xs = mesh_lib.make_global_batch(x, mesh8)
     sharded_grad = jax.jit(jax.grad(loss))(w, xs)
     np.testing.assert_allclose(np.asarray(sharded_grad), np.asarray(expected), rtol=1e-5)
+
+
+def test_parse_mesh_axes():
+    from dmlcloud_tpu.parallel.mesh import parse_mesh_axes
+
+    assert parse_mesh_axes("data=2,fsdp=4") == {"data": 2, "fsdp": 4}
+    assert parse_mesh_axes("data=-1") == {"data": -1}
+
+
+def test_parse_mesh_axes_rejects_malformed():
+    import pytest
+
+    from dmlcloud_tpu.parallel.mesh import parse_mesh_axes
+
+    with pytest.raises(ValueError, match="malformed"):
+        parse_mesh_axes("data")
+    with pytest.raises(ValueError, match="malformed"):
+        parse_mesh_axes("data=two")
+
+
+def test_parse_mesh_axes_rejects_duplicate_axis():
+    """'data=2,data=4' used to silently become {'data': 4} — a dict overwrite
+    that dropped the first size without a word."""
+    import pytest
+
+    from dmlcloud_tpu.parallel.mesh import parse_mesh_axes
+
+    with pytest.raises(ValueError, match="more than once"):
+        parse_mesh_axes("data=2,data=4")

@@ -1,0 +1,160 @@
+"""Registry lookup semantics: optimizer-to-model binding must be
+unambiguous (mirroring _model_entry's error), and val_epoch may only
+swallow the missing-registration sentinel — never a user ValueError."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.stage import DatasetNotFoundError
+
+
+def _register_model(pipeline, name):
+    pipeline.register_model(
+        name,
+        apply_fn=lambda p, x: x @ p["w"],
+        params={"w": jnp.zeros((4, 1))},
+        verbose=False,
+    )
+
+
+@pytest.fixture
+def pipeline(single_runtime):
+    return dml.TrainingPipeline(name="registry")
+
+
+class TestOptimizerBinding:
+    def test_single_unbound_optimizer_serves_any_model(self, pipeline):
+        _register_model(pipeline, "a")
+        _register_model(pipeline, "b")
+        opt = optax.sgd(0.1)
+        pipeline.register_optimizer("sgd", opt)
+        assert pipeline._optimizer_for("a") is opt
+        assert pipeline._optimizer_for("b") is opt
+
+    def test_explicit_binding_wins(self, pipeline):
+        _register_model(pipeline, "a")
+        _register_model(pipeline, "b")
+        opt_a, opt_b = optax.sgd(0.1), optax.adam(1e-3)
+        pipeline.register_optimizer("sgd", opt_a, model="a")
+        pipeline.register_optimizer("adam", opt_b, model="b")
+        assert pipeline._optimizer_for("a") is opt_a
+        assert pipeline._optimizer_for("b") is opt_b
+
+    def test_ambiguous_unbound_optimizers_raise(self, pipeline):
+        """Two models + two unbound optimizers: the old code silently bound
+        the FIRST optimizer to both models."""
+        _register_model(pipeline, "a")
+        _register_model(pipeline, "b")
+        pipeline.register_optimizer("sgd", optax.sgd(0.1))
+        pipeline.register_optimizer("adam", optax.adam(1e-3))
+        with pytest.raises(ValueError, match="Multiple unbound optimizers"):
+            pipeline._optimizer_for("a")
+
+    def test_one_bound_one_unbound_is_unambiguous(self, pipeline):
+        _register_model(pipeline, "a")
+        _register_model(pipeline, "b")
+        opt_a, opt_rest = optax.sgd(0.1), optax.adam(1e-3)
+        pipeline.register_optimizer("sgd", opt_a, model="a")
+        pipeline.register_optimizer("adam", opt_rest)
+        assert pipeline._optimizer_for("a") is opt_a
+        assert pipeline._optimizer_for("b") is opt_rest
+
+    def test_two_bound_to_same_model_raise(self, pipeline):
+        _register_model(pipeline, "a")
+        pipeline.register_optimizer("sgd", optax.sgd(0.1), model="a")
+        pipeline.register_optimizer("adam", optax.adam(1e-3), model="a")
+        with pytest.raises(ValueError, match="Multiple optimizers"):
+            pipeline._optimizer_for("a")
+
+    def test_no_optimizer_raises(self, pipeline):
+        _register_model(pipeline, "a")
+        with pytest.raises(ValueError, match="No optimizer registered"):
+            pipeline._optimizer_for("a")
+
+    def test_bound_elsewhere_only_raises(self, pipeline):
+        _register_model(pipeline, "a")
+        _register_model(pipeline, "b")
+        pipeline.register_optimizer("sgd", optax.sgd(0.1), model="a")
+        with pytest.raises(ValueError, match="No optimizer registered for model 'b'"):
+            pipeline._optimizer_for("b")
+
+    def test_single_model_multiple_unbound_keeps_first(self, pipeline):
+        """One model with several unbound optimizers stays on the historical
+        first-wins behavior (no real ambiguity about WHICH model trains)."""
+        _register_model(pipeline, "a")
+        opt1 = optax.sgd(0.1)
+        pipeline.register_optimizer("sgd", opt1)
+        pipeline.register_optimizer("adam", optax.adam(1e-3))
+        assert pipeline._optimizer_for("a") is opt1
+
+    def test_end_to_end_two_models_two_optimizers(self, single_runtime):
+        """Behavior test through a real run: the ambiguity error must surface
+        from make_state, not train silently with the wrong optimizer."""
+
+        class AmbiguousStage(dml.TrainValStage):
+            def model_name(self):
+                return "a"
+
+            def pre_stage(self):
+                _register_model(self.pipeline, "a")
+                _register_model(self.pipeline, "b")
+                self.pipeline.register_optimizer("sgd", optax.sgd(0.1))
+                self.pipeline.register_optimizer("adam", optax.adam(1e-3))
+                rng = np.random.RandomState(0)
+                x = rng.randn(8, 4).astype(np.float32)
+                self.pipeline.register_dataset(
+                    "train", [{"x": x, "y": x @ rng.randn(4, 1).astype(np.float32)}], verbose=False
+                )
+
+            def step(self, state, batch):
+                pred = state.apply_fn(state.params, batch["x"])
+                return jnp.mean((pred - batch["y"]) ** 2)
+
+        pipeline = dml.TrainingPipeline(name="ambig")
+        pipeline.append_stage(AmbiguousStage(), max_epochs=1)
+        with pytest.raises(ValueError, match="Multiple unbound optimizers"):
+            pipeline.run()
+
+
+class _LinStage(dml.TrainValStage):
+    def pre_stage(self):
+        _register_model(self.pipeline, "lin")
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.1))
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4).astype(np.float32)
+        self.pipeline.register_dataset(
+            "train", [{"x": x, "y": x @ rng.randn(4, 1).astype(np.float32)}], verbose=False
+        )
+
+    def step(self, state, batch):
+        pred = state.apply_fn(state.params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+
+class TestValEpochErrorHandling:
+    def test_missing_val_dataset_skips_validation(self, single_runtime):
+        pipeline = dml.TrainingPipeline(name="noval")
+        pipeline.append_stage(_LinStage(), max_epochs=1)
+        pipeline.run()  # no val dataset registered: val silently skipped
+        assert "val/loss" not in pipeline.tracker
+
+    def test_user_val_dataset_valueerror_propagates(self, single_runtime):
+        """A ValueError raised by a user override is a BUG — it must not be
+        mistaken for "validation not configured" and swallowed forever."""
+
+        class BuggyVal(_LinStage):
+            def val_dataset(self):
+                raise ValueError("user bug: bad split fraction")
+
+        pipeline = dml.TrainingPipeline(name="buggyval")
+        pipeline.append_stage(BuggyVal(), max_epochs=1)
+        with pytest.raises(ValueError, match="user bug"):
+            pipeline.run()
+
+    def test_sentinel_subclasses_valueerror(self):
+        # back-compat: callers catching ValueError around train_dataset()
+        # keep working
+        assert issubclass(DatasetNotFoundError, ValueError)

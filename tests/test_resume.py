@@ -238,6 +238,68 @@ def test_invalid_stage_name_rejected(single_runtime, bad):
         pipeline.append_stage(_ToyStage(), max_epochs=1, name=bad)
 
 
+def test_resume_with_save_in_flight_uses_last_completed(tmp_path, single_runtime):
+    """A run killed WITH an async save still in flight must resume from the
+    last COMPLETED checkpoint: Orbax commits via tmp-dir + rename, so an
+    uncommitted save is invisible to latest_step(). Emulated deterministically
+    by planting the tmp directory a kill mid-commit leaves behind."""
+    p1, s1 = _run(tmp_path / "k", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+
+    # the kill artifact: epoch 3's save was dispatched but never committed
+    scope_dir = p1.checkpoint_dir.state_dir / "TrainValStage"
+    (scope_dir / "3.orbax-checkpoint-tmp-1234567890").mkdir()
+    # the root may also have written epoch 3's sidecar before dying — resume
+    # must key off Orbax's committed steps, not the sidecar
+    meta_dir = p1.checkpoint_dir.path / "meta" / "TrainValStage"
+    (meta_dir / "3.json").write_text((meta_dir / "2.json").read_text())
+
+    p2, s2 = _run(tmp_path / "k", resume_from=run_dir, max_epochs=5)
+    assert p2.resumed is True
+    assert s2.current_epoch == 6  # resumed at 3 (last completed = 2), ran 3..5
+    p2.checkpoint_dir.close()
+
+    # bit-exact equivalence with an uninterrupted control run
+    p3, s3 = _run(tmp_path / "kc", max_epochs=5)
+    np.testing.assert_allclose(
+        np.asarray(s2.state.params["w"]), np.asarray(s3.state.params["w"]), rtol=1e-6, atol=1e-7
+    )
+    p3.checkpoint_dir.close()
+
+
+def test_resume_with_sync_checkpointing_matches(tmp_path, single_runtime):
+    """async_checkpoint() False (the bisection baseline) must resume to the
+    exact same weights as the async default."""
+
+    class SyncCkpt(_ToyStage):
+        def async_checkpoint(self):
+            return False
+
+    def run_sync(root, resume_from=None, max_epochs=5):
+        pipeline = dml.TrainingPipeline(name="toy")
+        stage = SyncCkpt()
+        pipeline.append_stage(stage, max_epochs=max_epochs, name="TrainValStage")
+        if resume_from is not None:
+            pipeline.enable_checkpointing(resume_from, resume=True)
+        else:
+            pipeline.enable_checkpointing(str(root))
+        pipeline.run()
+        return pipeline, stage
+
+    p1, _ = run_sync(tmp_path / "sync", max_epochs=2)
+    run_dir = str(p1.checkpoint_dir)
+    p1.checkpoint_dir.close()
+    p2, s2 = run_sync(tmp_path / "sync", resume_from=run_dir, max_epochs=5)
+    p2.checkpoint_dir.close()
+
+    p3, s3 = _run(tmp_path / "async", max_epochs=5)  # async default, uninterrupted
+    np.testing.assert_allclose(
+        np.asarray(s2.state.params["w"]), np.asarray(s3.state.params["w"]), rtol=1e-6, atol=1e-7
+    )
+    p3.checkpoint_dir.close()
+
+
 def test_checkpoint_every_zero_disables_state_saves(tmp_path, single_runtime):
     class NoCkptStage(_ToyStage):
         def checkpoint_every(self):

@@ -149,9 +149,55 @@ class TestBarrierDiagnostics:
     def test_success_leaves_arrival_key(self, single_runtime, monkeypatch):
         """Arrival keys persist after a successful barrier — deleting them
         would let a marginal-race prober misname arrived ranks."""
+        monkeypatch.setattr(runtime, "_gc_barrier_ids", [])
         client = _FakeClient()
         self._run_barrier(monkeypatch, client)
         assert any("/arrived/0" in k for k in client.kv)
+
+    def test_completed_barrier_keys_swept_one_barrier_later(self, single_runtime, monkeypatch):
+        """The coordinator's KV store must not accrue O(barriers) arrival
+        keys on long jobs: once a LATER barrier completes, every rank has
+        provably left the earlier one, so the root sweeps its keys. The
+        just-completed barrier's own keys stay (straggler-race safety)."""
+        monkeypatch.setattr(runtime, "_gc_barrier_ids", [])
+        client = _FakeClient()
+        self._run_barrier(monkeypatch, client)
+        first_keys = [k for k in client.kv if "/arrived/" in k]
+        assert first_keys  # barrier 1's keys present after barrier 1
+        self._run_barrier(monkeypatch, client)
+        remaining = [k for k in client.kv if "/arrived/" in k]
+        assert all(k not in remaining for k in first_keys)  # swept
+        assert remaining  # barrier 2's own keys survive until barrier 3
+        self._run_barrier(monkeypatch, client)
+        assert all(k not in client.kv for k in remaining)
+
+    def test_failed_barrier_does_not_sweep(self, single_runtime, monkeypatch):
+        """A timed-out barrier must leave the previous barrier's keys alone —
+        its straggler probe (and any retry's) may still need them."""
+        monkeypatch.setattr(runtime, "_gc_barrier_ids", [])
+        client = _FakeClient()
+        self._run_barrier(monkeypatch, client)
+        first_keys = [k for k in client.kv if "/arrived/" in k]
+        client.wait_error = RuntimeError("DEADLINE_EXCEEDED while waiting")
+        with pytest.raises(runtime.BarrierTimeout):
+            self._run_barrier(monkeypatch, client)
+        assert all(k in client.kv for k in first_keys)
+
+    def test_non_root_does_not_sweep(self, single_runtime, monkeypatch):
+        monkeypatch.setattr(runtime, "_gc_barrier_ids", [])
+        client = _FakeClient()
+        self._run_barrier(monkeypatch, client, my_rank=1)
+        first_keys = [k for k in client.kv if "/arrived/" in k]
+        self._run_barrier(monkeypatch, client, my_rank=1)
+        assert all(k in client.kv for k in first_keys)  # root's job, not ours
+
+
+def test_call_site_tag_includes_parent_dir():
+    """A bare basename collides across packages (every repo has a train.py);
+    the tag carries the last TWO path components."""
+    tag = runtime.broadcast_object.__globals__["_call_site_tag"]()
+    assert tag.count("/") == 1  # exactly dir/file.py:lineno
+    assert tag.startswith("tests/test_runtime.py:")
 
 
 class TestInitLadder:

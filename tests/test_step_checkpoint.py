@@ -199,6 +199,60 @@ def test_corrupt_sidecar_step_only_mode_still_restores_weights(tmp_path):
     assert int(stage2.state.step) == 6 + 2 * BATCHES_PER_EPOCH
 
 
+def test_interrupted_inflight_step_save_resumes_from_committed(tmp_path):
+    """A kill with a mid-epoch step save still in flight (async writer never
+    committed) must resume from the last COMMITTED step save — the planted
+    Orbax tmp dir emulates exactly what the kill leaves on disk."""
+    batches = _make_batches()
+    ds = _PreemptAfter(batches, kill_after=5)
+    pipe1, stage1 = _run(tmp_path / "run", ds, preemptible=True)
+    assert int(stage1.state.step) == 6
+
+    # the kill artifact: a step-9 save that never committed
+    steps_dir = pipe1.checkpoint_dir.state_dir / f"{stage1.name}.steps"
+    (steps_dir / "9.orbax-checkpoint-tmp-1234567890").mkdir()
+    assert pipe1.checkpoint_dir.latest_step(scope=f"{stage1.name}.steps") == 6
+
+    _, control = _run(tmp_path / "control", batches)
+    pipe2, stage2 = _run(pipe1.checkpoint_dir.path, _PreemptAfter(batches))
+    assert int(stage2.state.step) == 2 * BATCHES_PER_EPOCH
+    np.testing.assert_array_equal(
+        np.asarray(stage2.state.params["Dense_0"]["kernel"]),
+        np.asarray(control.state.params["Dense_0"]["kernel"]),
+    )
+
+
+def test_step_saves_sync_mode_bit_identical(tmp_path):
+    """async_checkpoint() False through the mid-epoch preempt/resume path
+    must land on the same weights as the async default."""
+
+    class SyncStage(_Stage):
+        def async_checkpoint(self):
+            return False
+
+    batches = _make_batches()
+    _, control = _run(tmp_path / "control", batches)
+
+    ds = _PreemptAfter(batches, kill_after=5)
+    pipe1 = dml.TrainingPipeline(name="syncstep")
+    pipe1.enable_checkpointing(str(tmp_path / "sync"), resume=True)
+    pipe1.enable_preemption_handling(("SIGUSR1",))
+    stage1 = SyncStage(ds)
+    pipe1.append_stage(stage1, max_epochs=2)
+    pipe1.run()
+    assert int(stage1.state.step) == 6
+
+    pipe2 = dml.TrainingPipeline(name="syncstep")
+    pipe2.enable_checkpointing(str(pipe1.checkpoint_dir.path), resume=True)
+    stage2 = SyncStage(_PreemptAfter(batches))
+    pipe2.append_stage(stage2, max_epochs=2)
+    pipe2.run()
+    np.testing.assert_array_equal(
+        np.asarray(stage2.state.params["Dense_0"]["kernel"]),
+        np.asarray(control.state.params["Dense_0"]["kernel"]),
+    )
+
+
 def test_step_saves_disabled_by_default(tmp_path):
     batches = _make_batches()
     pipe, stage = _run(tmp_path, batches, epochs=1, every_steps=0)
