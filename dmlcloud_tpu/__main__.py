@@ -1,23 +1,33 @@
-"""``python -m dmlcloud_tpu`` — environment / topology diagnostics CLI.
+"""``python -m dmlcloud_tpu`` — the framework's CLI, as subcommands.
 
-Prints the same reproducibility block a TrainingPipeline logs at run start
-(versions, git state, accelerator topology, Slurm env), without starting a
-run — the first thing to ask for when a cluster job misbehaves. The
-reference has no CLI; its equivalent is buried in run logs
-(util/logging.py:131-173).
+- ``diag`` (the default): environment / topology diagnostics — the same
+  reproducibility block a TrainingPipeline logs at run start (versions, git
+  state, accelerator topology, Slurm env) without starting a run; the first
+  thing to ask for when a cluster job misbehaves. The reference has no CLI;
+  its equivalent is buried in run logs (util/logging.py:131-173).
+- ``lint``: the AST-based TPU-hazard linter (doc/lint.md) — enforces the
+  overlap engine's sync-point contract on CPU, no jax import needed.
 
-    python -m dmlcloud_tpu              # full diagnostics
-    python -m dmlcloud_tpu --json      # machine-readable subset
+    python -m dmlcloud_tpu                  # diagnostics (diag is implied)
+    python -m dmlcloud_tpu --json           # machine-readable diagnostics
+    python -m dmlcloud_tpu diag [--json]
+    python -m dmlcloud_tpu lint [paths...] [--json] [--list-rules]
+
+The bare invocation (no subcommand) stays diag for backward compatibility
+with existing wrappers and docs.
 """
 
 import argparse
 import json
 import sys
 
+_SUBCOMMANDS = ("diag", "lint")
 
-def main(argv=None) -> int:
+
+def _diag_main(argv) -> int:
     parser = argparse.ArgumentParser(
-        prog="python -m dmlcloud_tpu", description="Print environment/topology diagnostics."
+        prog="python -m dmlcloud_tpu diag",
+        description="Print environment/topology diagnostics.",
     )
     parser.add_argument("--json", action="store_true", help="machine-readable subset")
     args = parser.parse_args(argv)
@@ -36,6 +46,25 @@ def main(argv=None) -> int:
     info.update(accelerator_info())  # {"error": ...} when backend init fails
     print(json.dumps(info))
     return 1 if "error" in info else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        from .lint.cli import main as lint_main
+
+        return lint_main(argv[1:])
+    if argv and argv[0] == "diag":
+        argv = argv[1:]
+    elif argv and not argv[0].startswith("-"):
+        print(
+            f"python -m dmlcloud_tpu: unknown subcommand {argv[0]!r} "
+            f"(choose from {', '.join(_SUBCOMMANDS)})",
+            file=sys.stderr,
+        )
+        return 2
+    # bare invocation (flags only) == diag, the historical behavior
+    return _diag_main(argv)
 
 
 if __name__ == "__main__":

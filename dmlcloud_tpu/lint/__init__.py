@@ -1,0 +1,52 @@
+"""``dmlcloud_tpu.lint`` — AST-based TPU-hazard linter.
+
+PR 1's overlap engine removed every host-sync point from the hot loop
+(1.65x steps/s on the CPU smoke A/B); this package keeps it that way. A
+pure-stdlib AST pass detects, at review time and on CPU, the hazard
+patterns the framework exists to avoid — the things that silently claw the
+win back when the next ``Stage`` subclass reintroduces them:
+
+==========  ============================================================
+DML101      host sync inside step/epoch code (``.item()``, ``float()``/
+            ``np.asarray()`` on traced values, ``jax.device_get``,
+            ``print`` of arrays) — defeats ``deferred_metrics()``
+DML102      Python/NumPy RNG inside a jitted step fn — baked in at trace
+            time, breaks reproducibility and randomness at once
+DML103      ``jax.jit``/``pjit`` train step without donated train state —
+            params + optimizer state held twice in HBM
+DML104      retrace hazards: data-dependent ``if``/``while``/iteration on
+            traced values (runtime companion: :class:`TraceGuard`)
+DML105      blocking ``checkpoint.save``/``wandb`` calls inside the epoch
+            loop — serialization/network on the training thread
+DML106      wall-clock timing of dispatches without ``block_until_ready``
+            — benchmarks that measure enqueue cost, not execution
+==========  ============================================================
+
+Entry points: ``lint_source``/``lint_file``/``lint_paths`` (library),
+``python -m dmlcloud_tpu lint`` (CLI), ``TrainingPipeline(lint="warn")``
+(runtime, lints registered Stage subclasses at run start). Suppress a
+finding with ``# dmllint: disable=DML101 -- justification``. Full catalog
+with bad/good examples: doc/lint.md.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintError,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from . import rules  # noqa: F401  — importing registers the rules
+from .traceguard import RetraceError, TraceGuard  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintError",
+    "RULES",
+    "RetraceError",
+    "TraceGuard",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

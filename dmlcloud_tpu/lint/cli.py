@@ -1,0 +1,103 @@
+"""``python -m dmlcloud_tpu lint`` — the CLI front end.
+
+Human output is one ``path:line:col: RULE message`` per finding (clickable
+in editors/CI logs); ``--json`` emits one stable machine-readable object::
+
+    {
+      "version": 1,
+      "files_scanned": 12,
+      "findings": [{"rule", "path", "line", "col", "message", "context"}...],
+      "counts": {"DML101": 2}
+    }
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Pure stdlib — no jax
+import, safe to run anywhere (pre-commit hooks, CPU-only CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import RULES, lint_file, iter_python_files
+
+
+def _parse_ids(spec: str) -> list[str]:
+    ids = [p.strip() for p in spec.split(",") if p.strip()]
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise argparse.ArgumentTypeError(
+            f"unknown rule id(s) {', '.join(unknown)}; known: {', '.join(sorted(RULES))}"
+        )
+    return ids
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlcloud_tpu lint",
+        description="AST-based TPU-hazard linter enforcing the overlap engine's "
+        "sync-point contract (doc/lint.md).",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["."],
+        help="files and/or directories to lint recursively (default: .)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--select", type=_parse_ids, default=None, metavar="IDS",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", type=_parse_ids, default=None, metavar="IDS",
+        help="comma-separated rule ids to skip",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors already; normalize --help's 0
+        return int(e.code or 0)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid].title}")
+        return 0
+
+    findings = []
+    files_scanned = 0
+    for fpath in iter_python_files(args.paths):
+        files_scanned += 1
+        findings.extend(lint_file(fpath, select=args.select, ignore=args.ignore))
+    findings.sort(key=lambda f: f.sort_key())
+
+    if args.json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "files_scanned": files_scanned,
+                    "findings": [f.to_dict() for f in findings],
+                    "counts": {k: counts[k] for k in sorted(counts)},
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        noun = "file" if files_scanned == 1 else "files"
+        if findings:
+            print(f"{len(findings)} finding(s) in {files_scanned} {noun} scanned")
+        else:
+            print(f"clean: {files_scanned} {noun} scanned, 0 findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""TraceGuard — DML104's runtime companion.
+
+Static analysis catches the *lexical* retrace hazards (data-dependent
+``if``/``while`` on traced values); it cannot see a Python-scalar closure
+that changes every step or a batch whose shape drifts. TraceGuard catches
+those at runtime on CPU: it wraps a jitted callable and reads jax's own
+compilation-cache size (``fn._cache_size()``) after every call — the cache
+growing past ``max_traces`` means XLA recompiled, i.e. something in the
+call signature was not stable.
+
+Usage::
+
+    step = TraceGuard(jax.jit(step_fn), max_traces=1)
+    for batch in ds:
+        state, metrics = step(state, batch)   # raises RetraceError on retrace
+
+``action="warn"`` logs instead of raising (one message per growth event) —
+the right mode for production loops where a retrace is a perf bug, not a
+correctness bug. The guard is zero-overhead beyond one int comparison per
+call and never touches device values.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["TraceGuard", "RetraceError"]
+
+_logger = logging.getLogger(__name__)
+
+
+class RetraceError(RuntimeError):
+    """A guarded jitted function compiled more distinct traces than allowed."""
+
+
+class TraceGuard:
+    """Wrap a jitted callable and watch its compilation cache across calls.
+
+    Parameters:
+        fn: the jitted callable (anything exposing jax's ``_cache_size``;
+            callables without it pass through unguarded).
+        max_traces: how many distinct compilations are legitimate (1 for a
+            fixed-shape train step; N for N intentional shape buckets).
+        action: ``"raise"`` (default) raises :class:`RetraceError`;
+            ``"warn"`` logs a warning once per growth event.
+        name: label used in messages (default: the wrapped fn's ``__name__``).
+    """
+
+    def __init__(self, fn, *, max_traces: int = 1, action: str = "raise", name: str | None = None):
+        if action not in ("raise", "warn"):
+            raise ValueError(f"action must be 'raise' or 'warn', got {action!r}")
+        if max_traces < 1:
+            raise ValueError(f"max_traces must be >= 1, got {max_traces}")
+        self._fn = fn
+        self.max_traces = int(max_traces)
+        self.action = action
+        self.name = name or getattr(fn, "__name__", None) or type(fn).__name__
+        self.calls = 0
+        self._last_reported = 0
+
+    def cache_size(self) -> int | None:
+        """Current number of distinct compilations, or None if the wrapped
+        callable does not expose a cache (not a jitted function)."""
+        probe = getattr(self._fn, "_cache_size", None)
+        if callable(probe):
+            try:
+                return int(probe())
+            except Exception:
+                return None
+        return None
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self.calls += 1
+        n = self.cache_size()
+        if n is not None and n > self.max_traces and n > self._last_reported:
+            self._last_reported = n
+            msg = (
+                f"TraceGuard[{self.name}]: {n} distinct traces after "
+                f"{self.calls} calls (allowed {self.max_traces}) — the call "
+                "signature is not stable (changing Python scalars, drifting "
+                "shapes/dtypes, or data-dependent structure); each retrace is "
+                "a full XLA compile (lint rule DML104)"
+            )
+            if self.action == "raise":
+                raise RetraceError(msg)
+            _logger.warning(msg)
+        return out

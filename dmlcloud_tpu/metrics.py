@@ -214,7 +214,10 @@ def _name_fingerprint(names: list[str]) -> np.float32:
     return np.float32(zlib.crc32("\x00".join(names).encode()) % (2**24 - 3))
 
 
-#: Metrics already warned about (once per process) for float32 exactness loss.
+#: Process-wide fallback of metrics already warned about for float32
+#: exactness loss — used only when the caller passes no ``warned`` set.
+#: ``MetricTracker`` owns a per-tracker set instead, so a second pipeline
+#: (or test) in the same process warns again for its own metrics.
 _INEXACT_SUM_WARNED: set[str] = set()
 
 
@@ -222,6 +225,7 @@ def _pack_scalar_metrics(
     names: list[str],
     local: dict[str, tuple[bool, Any]],
     reductions: dict[str, Reduction] | None = None,
+    warned: set[str] | None = None,
 ) -> np.ndarray:
     """``[fingerprint | empty bits | values]`` as one float32 vector — the
     payload of the single-collective epoch exchange.
@@ -231,26 +235,35 @@ def _pack_scalar_metrics(
     identical on every rank or the collective shapes diverge), so the guard
     is a loud once-per-metric warning naming the exact fix; the cross-rank
     combine itself happens in float64 (``_unpack_scalar_metrics``), so the
-    pack-time rounding checked here is the only loss point."""
+    pack-time rounding checked here is the only loss point. ``warned``
+    scopes the once-per-metric dedupe (default: the process-wide set)."""
+    if warned is None:
+        warned = _INEXACT_SUM_WARNED
     n = len(names)
     vec = np.zeros(1 + 2 * n, np.float32)
     vec[0] = _name_fingerprint(names)
-    for i, name in enumerate(names):
-        empty, val = local[name]
-        vec[1 + i] = 1.0 if empty else 0.0
-        if not empty:
-            vec[1 + n + i] = np.float32(val)
-            if reductions is not None and reductions.get(name) is Reduction.SUM:
-                v = float(np.asarray(val))
-                if v == round(v) and float(vec[1 + n + i]) != v and name not in _INEXACT_SUM_WARNED:
-                    _INEXACT_SUM_WARNED.add(name)
-                    _logger.warning(
-                        "Metric %r: integer SUM counter %.0f exceeds float32's exact "
-                        "range (2**24) and loses precision in the packed metric "
-                        "exchange. Register it with dim=() to route it through the "
-                        "exact object exchange, or track a float statistic instead.",
-                        name, v,
-                    )
+    empties = np.array([bool(local[name][0]) for name in names], bool)
+    # one host conversion pass; both the packed f32 payload and the
+    # exactness check below read from this vector
+    vals = np.array(
+        [0.0 if e else float(np.asarray(local[nm][1])) for nm, e in zip(names, empties)],
+        np.float64,
+    )
+    vec[1 : 1 + n] = empties
+    vec[1 + n :] = vals  # f64 -> f32 cast happens here, once
+    if reductions is not None:
+        lossy = (vals == np.round(vals)) & (vec[1 + n :].astype(np.float64) != vals) & ~empties
+        for i in np.nonzero(lossy)[0]:
+            name = names[int(i)]
+            if reductions.get(name) is Reduction.SUM and name not in warned:
+                warned.add(name)
+                _logger.warning(
+                    "Metric %r: integer SUM counter %.0f exceeds float32's exact "
+                    "range (2**24) and loses precision in the packed metric "
+                    "exchange. Register it with dim=() to route it through the "
+                    "exact object exchange, or track a float statistic instead.",
+                    name, vals[int(i)],
+                )
     return vec
 
 
@@ -300,6 +313,10 @@ class MetricTracker:
         self.histories: dict[str, list] = {}
         self.reducers: dict[str, MetricReducer] = {}
         self.epoch = 1
+        #: per-tracker once-per-metric dedupe for the inexact-SUM warning —
+        #: a second pipeline/test in the same process warns again (not
+        #: persisted: a resumed run re-warning once is correct)
+        self._inexact_sum_warned: set[str] = set()
 
     def __getitem__(self, name: str) -> list:
         """History of a metric for *completed* epochs (current epoch's
@@ -399,7 +416,9 @@ class MetricTracker:
             other = {n: local[n] for n in local if n not in scalar_names}
             if scalar_names:
                 reductions = {n: self.reducers[n].reduction for n in scalar_names}
-                packed = _pack_scalar_metrics(scalar_names, local, reductions)
+                packed = _pack_scalar_metrics(
+                    scalar_names, local, reductions, warned=self._inexact_sum_warned
+                )
                 gathered = runtime.all_gather_array(packed)
                 fused.update(_unpack_scalar_metrics(scalar_names, gathered, reductions))
             if other:

@@ -41,6 +41,15 @@ from .flash_attention import flash_attention
 _NEG_INF = -1e30
 
 
+def _axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` across jax versions: 0.4.x has no such
+    function — ``psum(1, axis)`` is the classic idiom there (folded to a
+    compile-time constant for a concrete mesh axis)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def _merge_partials(m, w, acc, out_b, lse_b):
     """Blockwise combination of normalized attention partials:
     out = Σ_b exp(lse_b)·out_b / Σ_b exp(lse_b), carried with a running max
@@ -77,7 +86,7 @@ def ring_attention(
     long-context windowed training communicates O(W), not O(T).
     """
     b, tl, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     if window is not None:
@@ -156,7 +165,7 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
     from .flash_attention import _auto_block, _flash_lse
 
     b, tl, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     if sm_scale is None:
         sm_scale = 1.0 / _math.sqrt(d)
@@ -245,6 +254,8 @@ def ring_attention_sharded(
         interpret=interpret,
         window=window,
     )
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q, check_vma=False
+    from ..parallel.mesh import shard_map_compat
+
+    return shard_map_compat(
+        fn, mesh=mesh, in_specs=(spec_q, spec_q, spec_q), out_specs=spec_q
     )(q, k, v)

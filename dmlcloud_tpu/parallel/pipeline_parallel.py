@@ -103,12 +103,11 @@ def pipeline_apply(
     act_spec = P(None, batch_axes)  # [n_micro, micro_b, ...]
 
     fn = partial(_pipeline_local, stage_fn, n_stages=n_stages, n_micro=n_micro, axis=axis)
-    return jax.shard_map(
+    return mesh_lib.shard_map_compat(
         fn,
         mesh=mesh,
         in_specs=(P(axis), act_spec),
         out_specs=act_spec,
-        check_vma=False,
     )(stacked_params, x)
 
 

@@ -51,9 +51,18 @@ class ModelEntry:
 
 
 class TrainingPipeline:
-    def __init__(self, config: Any = None, name: Optional[str] = None):
+    def __init__(self, config: Any = None, name: Optional[str] = None, lint: Optional[str] = None):
+        """``lint`` arms the TPU-hazard linter (dmlcloud_tpu.lint) over every
+        registered Stage subclass's source at run start: ``"warn"`` logs the
+        findings, ``"error"`` raises ``lint.LintError`` before any device
+        work happens. None (default) skips linting — the CLI
+        (``python -m dmlcloud_tpu lint``) and the self-lint test remain the
+        review-time nets."""
+        if lint not in (None, "warn", "error"):
+            raise ValueError(f'lint must be None, "warn" or "error", got {lint!r}')
         self.config: Config = as_config(config)
         self.name = name
+        self._lint_mode = lint
 
         self.logger = logging.getLogger("dmlcloud_tpu")
         self.checkpoint_dir: CheckpointDir | None = None
@@ -422,9 +431,51 @@ class TrainingPipeline:
             self.mesh = mesh_lib.create_mesh({mesh_lib.DATA: -1})
         runtime._cpu_safety_flags()
 
+    def _lint_stages(self) -> None:
+        """Lint every registered Stage subclass's source (the runtime arm of
+        dmlcloud_tpu.lint — catches hazards in stages assembled dynamically,
+        where no CLI run ever sees the file). Classes whose source is
+        unavailable (REPL, exec) are skipped: the linter is a net, not a
+        gate on how code gets defined."""
+        if self._lint_mode is None:
+            return
+        import inspect
+        import textwrap
+
+        from .lint import LintError, lint_source
+
+        findings = []
+        seen: set[type] = set()
+        for stage in self.stages:
+            cls = type(stage)
+            # framework-shipped stages are covered by the repo's own
+            # self-lint gate; lint only user subclasses, each class once
+            if cls in seen or cls.__module__.startswith("dmlcloud_tpu."):
+                continue
+            seen.add(cls)
+            try:
+                lines, start = inspect.getsourcelines(cls)
+                path = inspect.getsourcefile(cls) or f"<{cls.__name__}>"
+            except (OSError, TypeError):
+                continue
+            # re-anchor to the original line numbers so findings are clickable
+            src = "\n" * (start - 1) + textwrap.dedent("".join(lines))
+            findings.extend(lint_source(src, path=path))
+        if not findings:
+            return
+        report = "\n".join(f.format() for f in findings)
+        if self._lint_mode == "error":
+            raise LintError(
+                f"TPU-hazard linter found {len(findings)} problem(s) in registered "
+                f"stages (doc/lint.md; suppress with '# dmllint: disable=ID'):\n{report}",
+                findings,
+            )
+        self.logger.warning("TPU-hazard linter findings in registered stages:\n%s", report)
+
     def _pre_run(self):
         if len(self.stages) == 0:
             raise ValueError("No stages defined. Use append_stage() to add stages to the pipeline.")
+        self._lint_stages()
         if not runtime.is_initialized():
             runtime.init_auto()
 

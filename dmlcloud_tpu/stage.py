@@ -1183,7 +1183,10 @@ class TrainValStage(Stage):
                         loss_ema = v if loss_ema is None else 0.98 * loss_ema + 0.02 * v
                         _guard_loss(v, steps_done)
                 elif loss_val is not None:
-                    v = float(np.asarray(loss_val))  # already host-side
+                    # eager bisection path: the value is already host-side
+                    # (fetched under the stall timer in the device_get above)
+                    # dmllint: disable-next-line=DML101 -- converts, not syncs
+                    v = float(np.asarray(loss_val))
                     loss_ema = v if loss_ema is None else 0.98 * loss_ema + 0.02 * v
                     _guard_loss(v, steps_done)
 

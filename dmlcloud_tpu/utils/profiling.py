@@ -40,14 +40,24 @@ class StallTimer:
 
     def __init__(self):
         self._ns = 0
+        self._depth = 0
+        self._outer_t0 = 0
 
     @contextmanager
     def measure(self):
-        t0 = time.perf_counter_ns()
+        """Time a host-blocked span. Nesting-safe: a ``measure()`` (or
+        ``block()``/``fetch()``) inside an outer ``measure()`` contributes
+        nothing of its own — only the outermost span accumulates, so nested
+        blocks are never double-counted."""
+        self._depth += 1
+        if self._depth == 1:
+            self._outer_t0 = time.perf_counter_ns()
         try:
             yield
         finally:
-            self._ns += time.perf_counter_ns() - t0
+            self._depth -= 1
+            if self._depth == 0:
+                self._ns += time.perf_counter_ns() - self._outer_t0
 
     def block(self, tree):
         """``jax.block_until_ready`` under the timer (the epoch-end sync)."""

@@ -1,0 +1,26 @@
+"""DML101 bad fixture: unaccounted host syncs in step and epoch code.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+import numpy as np
+
+from dmlcloud_tpu import TrainValStage
+
+
+class SyncyStage(TrainValStage):
+    def step(self, state, batch):
+        loss = state.apply_fn(state.params, batch["x"]).mean()
+        print(loss)  # BAD: print inside a traced step
+        host = float(loss)  # BAD: concretizes a traced value
+        return loss + host
+
+    def train_epoch(self):
+        for batch in self.ds:
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            v = metrics["loss"].item()  # BAD: per-step .item() sync
+            host = jax.device_get(metrics)  # BAD: unaccounted device_get
+            f = float(metrics["loss"])  # BAD: per-step float() on a metric
+            arr = np.asarray(metrics["loss"])  # BAD: synchronous D2H copy
+            self.track_reduce("loss", v + f + arr.sum() + host["loss"])

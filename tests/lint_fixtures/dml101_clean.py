@@ -1,0 +1,28 @@
+"""DML101 clean fixture: the deferred-metrics contract — device values are
+tracked as-is, host blocks are accounted under the stall timer.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu import TrainValStage
+
+
+class DeferredStage(TrainValStage):
+    def step(self, state, batch):
+        loss = state.apply_fn(state.params, batch["x"]).mean()
+        return loss  # stays on device; the tracker reduces once per epoch
+
+    def train_epoch(self):
+        last = None
+        for batch in self.ds:
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            self.track_reduce("loss", metrics["loss"])  # no readback
+            last = metrics
+        if last is not None:
+            self._stall.block(last)  # the accounted epoch-end sync
+        ema = float(self._stall.fetch(last["loss"]))  # accounted fetch
+        with self._stall.measure():
+            host = jax.device_get(last)  # accounted readback
+        self.track("final", host["loss"] + ema)

@@ -1,0 +1,23 @@
+"""DML102 bad fixture: Python/NumPy RNG inside jitted step code.
+
+Static lint corpus — never imported or executed.
+"""
+
+import random
+
+import jax
+import numpy as np
+
+from dmlcloud_tpu import TrainValStage
+
+
+class RngStage(TrainValStage):
+    def step(self, state, batch):
+        noise = np.random.normal(size=(4,))  # BAD: baked in at trace time
+        keep = random.uniform(0.0, 1.0)  # BAD: stdlib RNG under trace
+        return (state.apply_fn(state.params, batch) + noise).mean() * keep
+
+
+@jax.jit
+def jitted_augment(x):
+    return x + np.random.rand(*x.shape)  # BAD: same constant every call

@@ -1,0 +1,21 @@
+"""DML102 clean fixture: jax.random keyed from the state; host RNG only in
+data prep (not a hazard context).
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+import numpy as np
+
+from dmlcloud_tpu import TrainValStage
+
+
+class SeededStage(TrainValStage):
+    def pre_stage(self):
+        rng = np.random.RandomState(0)  # fine: host-side data prep
+        self.data = rng.randn(64, 10)
+
+    def step(self, state, batch):
+        key = jax.random.fold_in(state.rng, state.step)
+        noise = jax.random.normal(key, (4,))
+        return (state.apply_fn(state.params, batch) + noise).mean()

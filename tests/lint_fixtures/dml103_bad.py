@@ -1,0 +1,26 @@
+"""DML103 bad fixture: jitted train steps that do not donate their input
+state — params + optimizer state live twice across the update.
+
+Static lint corpus — never imported or executed.
+"""
+
+import functools
+
+import jax
+
+
+def train_step(state, batch):
+    return state, batch
+
+
+compiled = jax.jit(train_step)  # BAD: no donate_argnums
+
+
+@jax.jit
+def other_train_step(state, batch):  # BAD: decorator form, no donation
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def train_update(state, batch, lr):  # BAD: partial form, no donation
+    return state

@@ -1,0 +1,26 @@
+"""DML103 clean fixture: donated train steps; val steps need no donation
+(their input state is reused next step).
+
+Static lint corpus — never imported or executed.
+"""
+
+import functools
+
+import jax
+
+
+def train_step(state, batch):
+    return state, batch
+
+
+def val_step(state, batch):
+    return batch
+
+
+compiled = jax.jit(train_step, donate_argnums=0)
+val_compiled = jax.jit(val_step)  # fine: val steps don't update state
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def other_train_step(state, batch):
+    return state

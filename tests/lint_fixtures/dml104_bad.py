@@ -1,0 +1,27 @@
+"""DML104 bad fixture: data-dependent Python control flow on traced values
+inside jitted step code — trace errors or a full XLA recompile per step.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu import TrainValStage
+
+
+@jax.jit
+def train_fn(state, batch, flag):
+    if batch.sum() > 0:  # BAD: branches on traced data
+        state = state + 1
+    while flag:  # BAD: loops on a traced value
+        flag = flag - 1
+    for row in batch:  # BAD: unrolls the trace over a traced value
+        state = state + row
+    return state
+
+
+class BranchyStage(TrainValStage):
+    def step(self, state, batch):
+        loss = state.apply_fn(state.params, batch).mean()
+        scale = 0.5 if loss > 1.0 else 1.0  # BAD: conditional on traced loss
+        return loss * scale

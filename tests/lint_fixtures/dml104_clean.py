@@ -1,0 +1,34 @@
+"""DML104 clean fixture: static branches (config scalars, static_argnames,
+None-checks, shape metadata) and traced selection via jnp.where/lax.
+
+Static lint corpus — never imported or executed.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dmlcloud_tpu import TrainValStage
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def train_fn(state, batch, n, mask=None):
+    if n > 3:  # fine: static arg
+        state = state * 2
+    if mask is None:  # fine: None-check is static under trace
+        mask = jnp.ones_like(batch)
+    if batch.shape[0] > 1:  # fine: shape metadata is static
+        state = state + 1
+    if isinstance(batch, dict):  # fine: structure is static
+        batch = batch["x"]
+    return jnp.where(batch * mask > 0, state, 0.0).sum()
+
+
+class WhereStage(TrainValStage):
+    def step(self, state, batch):
+        chunk = int(self.config.get("chunk", 0))
+        loss = state.apply_fn(state.params, batch).mean()
+        if chunk > 0:  # fine: config scalar, fixed per trace
+            loss = loss / chunk
+        return jnp.where(loss > 1.0, loss * 0.5, loss)

@@ -1,0 +1,18 @@
+"""DML105 bad fixture: blocking checkpoint/wandb I/O on the training thread
+inside the epoch loop.
+
+Static lint corpus — never imported or executed.
+"""
+
+import wandb
+
+from dmlcloud_tpu import TrainValStage
+
+
+class BlockingIOStage(TrainValStage):
+    def train_epoch(self):
+        for i, batch in enumerate(self.ds):
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            wandb.log({"step": i})  # BAD: HTTP round trip per step
+            if i % 100 == 0:
+                self.ckpt.save_state(i, {"params": 0})  # BAD: blocking save

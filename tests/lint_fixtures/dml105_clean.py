@@ -1,0 +1,21 @@
+"""DML105 clean fixture: metrics ride the tracker (wandb publishes once per
+epoch in the pipeline), saves are accounted under the stall timer.
+
+Static lint corpus — never imported or executed.
+"""
+
+import wandb
+
+from dmlcloud_tpu import TrainValStage
+
+
+class TrackedStage(TrainValStage):
+    def train_epoch(self):
+        for batch in self.ds:
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            self.track_reduce("loss", metrics["loss"])  # wandb gets it per epoch
+        with self._stall.measure():
+            self.ckpt.save_state(1, {"params": 0})  # accounted single-flight save
+
+    def post_epoch(self):
+        wandb.log({"custom": 1.0})  # fine: per-epoch hook, not the hot loop

@@ -1,0 +1,24 @@
+"""DML106 bad fixture: wall-clock timing of async dispatches without a
+device sync — the benchmark measures enqueue cost, not execution.
+
+Static lint corpus — never imported or executed.
+"""
+
+import time
+
+import jax
+
+
+def bench_steps(train_step, state, batch):
+    t0 = time.perf_counter()
+    for _ in range(100):
+        state, _ = train_step(state, batch)
+    elapsed = time.perf_counter() - t0  # BAD: nothing has finished yet
+    return 100 / elapsed
+
+
+def bench_jitted(fn, x):
+    f = jax.jit(fn)
+    start = time.time()
+    y = f(x)
+    return time.time() - start, y  # BAD: timed the dispatch only

@@ -1,0 +1,24 @@
+"""DML106 clean fixture: the timed region is closed with block_until_ready
+before the second clock read.
+
+Static lint corpus — never imported or executed.
+"""
+
+import time
+
+import jax
+
+
+def bench_steps(train_step, state, batch):
+    t0 = time.perf_counter()
+    for _ in range(100):
+        state, _ = train_step(state, batch)
+    jax.block_until_ready(state)  # drain the dispatch queue first
+    elapsed = time.perf_counter() - t0
+    return 100 / elapsed
+
+
+def load_data(path):  # two clock reads but no device work: not a benchmark
+    t0 = time.monotonic()
+    rows = open(path).readlines()
+    return rows, time.monotonic() - t0

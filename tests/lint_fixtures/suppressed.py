@@ -1,0 +1,35 @@
+"""Suppression fixture: every hazard here carries a dmllint directive, so
+the whole file must lint clean — exercises same-line, next-line, and
+file-wide forms.
+
+Static lint corpus — never imported or executed.
+"""
+# dmllint: disable-file=DML106 -- this corpus intentionally times dispatches
+
+import time
+
+import jax
+import numpy as np
+
+from dmlcloud_tpu import TrainValStage
+
+
+class JustifiedStage(TrainValStage):
+    def step(self, state, batch):
+        loss = state.apply_fn(state.params, batch).mean()
+        print(loss)  # dmllint: disable=DML101 -- trace-time debug, removed before merge
+        # dmllint: disable-next-line=DML102
+        noise = np.random.normal(size=(1,))
+        return loss + noise.sum()
+
+    def train_epoch(self):
+        for batch in self.ds:
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            v = metrics["loss"].item()  # dmllint: disable=all -- A/B experiment
+            self.track_reduce("loss", v)
+
+
+def bench(train_step, state, batch):
+    t0 = time.perf_counter()
+    state, _ = train_step(state, batch)
+    return time.perf_counter() - t0  # covered by the file-wide disable

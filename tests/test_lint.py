@@ -1,0 +1,295 @@
+"""dmlcloud_tpu.lint: fixture corpus per rule, suppression comments, CLI
+--json schema, TraceGuard retrace detection, and the pipeline's lint= arm.
+
+The fixture files under tests/lint_fixtures/ are static data (never
+imported): each bad file must produce findings for exactly its own rule,
+each clean file must produce none.
+"""
+
+import json
+import logging
+from pathlib import Path
+
+import pytest
+
+from dmlcloud_tpu.lint import (
+    RULES,
+    Finding,
+    LintError,
+    RetraceError,
+    TraceGuard,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from dmlcloud_tpu.lint.cli import main as lint_cli
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+#: rule -> expected number of findings in its bad fixture
+BAD_EXPECT = {
+    "DML101": 6,
+    "DML102": 3,
+    "DML103": 3,
+    "DML104": 4,
+    "DML105": 2,
+    "DML106": 2,
+}
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule_id", sorted(BAD_EXPECT))
+    def test_bad_fixture_flags_exactly_its_rule(self, rule_id):
+        findings = lint_file(FIXTURES / f"{rule_id.lower()}_bad.py")
+        assert findings, f"{rule_id} bad fixture produced no findings"
+        assert {f.rule for f in findings} == {rule_id}, [f.format() for f in findings]
+        assert len(findings) == BAD_EXPECT[rule_id], [f.format() for f in findings]
+
+    @pytest.mark.parametrize("rule_id", sorted(BAD_EXPECT))
+    def test_clean_fixture_is_clean(self, rule_id):
+        findings = lint_file(FIXTURES / f"{rule_id.lower()}_clean.py")
+        assert findings == [], [f.format() for f in findings]
+
+    def test_every_rule_has_a_fixture_pair(self):
+        for rule_id in RULES:
+            if rule_id == "DML999":
+                continue
+            assert (FIXTURES / f"{rule_id.lower()}_bad.py").is_file()
+            assert (FIXTURES / f"{rule_id.lower()}_clean.py").is_file()
+
+    def test_findings_report_real_locations(self):
+        findings = lint_file(FIXTURES / "dml101_bad.py")
+        src_lines = (FIXTURES / "dml101_bad.py").read_text().splitlines()
+        for f in findings:
+            assert 1 <= f.line <= len(src_lines)
+            assert "BAD" in src_lines[f.line - 1], f.format()
+            assert f.context  # all corpus hazards sit inside functions
+
+
+class TestSuppression:
+    def test_suppressed_fixture_is_clean(self):
+        assert lint_file(FIXTURES / "suppressed.py") == []
+
+    def test_same_line_directive(self):
+        src = (
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        v = loss.item()  # dmllint: disable=DML101 -- why\n"
+        )
+        assert lint_source(src) == []
+        # and without the directive the finding is real
+        assert [f.rule for f in lint_source(src.replace("  # dmllint: disable=DML101 -- why", ""))] == ["DML101"]
+
+    def test_next_line_directive(self):
+        src = (
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        # dmllint: disable-next-line=DML101\n"
+            "        v = loss.item()\n"
+        )
+        assert lint_source(src) == []
+
+    def test_file_wide_directive(self):
+        src = (
+            "# dmllint: disable-file=DML101\n"
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        v = loss.item()\n"
+            "        w = other.item()\n"
+        )
+        assert lint_source(src) == []
+
+    def test_disable_all(self):
+        src = (
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        v = loss.item()  # dmllint: disable=all\n"
+        )
+        assert lint_source(src) == []
+
+    def test_unrelated_id_does_not_suppress(self):
+        src = (
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        v = loss.item()  # dmllint: disable=DML104\n"
+        )
+        assert [f.rule for f in lint_source(src)] == ["DML101"]
+
+
+class TestEngineEdges:
+    def test_parse_error_is_a_finding(self):
+        findings = lint_source("def broken(:\n", path="x.py")
+        assert len(findings) == 1
+        assert findings[0].rule == "DML999"
+        assert "parse" in findings[0].message
+
+    def test_select_and_ignore(self):
+        bad = (FIXTURES / "dml101_bad.py").read_text()
+        assert lint_source(bad, select=["DML104"]) == []
+        assert lint_source(bad, ignore=["DML101"]) == []
+        assert {f.rule for f in lint_source(bad, select=["DML101"])} == {"DML101"}
+
+    def test_non_hazard_context_is_not_linted(self):
+        # float()/np.random/.item() outside step/epoch contexts lint clean:
+        # the rules are contract rules, not style rules
+        src = (
+            "import numpy as np\n"
+            "def load(path):\n"
+            "    rng = np.random.RandomState(0)\n"
+            "    v = float(rng.randn(1).item())\n"
+            "    return v\n"
+        )
+        assert lint_source(src) == []
+
+    def test_measure_block_exempts_sync(self):
+        src = (
+            "import jax\n"
+            "class S(TrainValStage):\n"
+            "    def train_epoch(self):\n"
+            "        with self._stall.measure():\n"
+            "            v = jax.device_get(metrics)\n"
+        )
+        assert lint_source(src) == []
+
+    def test_lint_paths_walks_directories(self):
+        findings = lint_paths([FIXTURES])
+        assert {f.rule for f in findings} == set(BAD_EXPECT)
+        assert findings == sorted(findings, key=Finding.sort_key)
+
+
+class TestCLI:
+    def test_json_schema_on_bad_fixture(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml101_bad.py"), "--json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["version"] == 1
+        assert payload["files_scanned"] == 1
+        assert payload["counts"] == {"DML101": BAD_EXPECT["DML101"]}
+        assert len(payload["findings"]) == BAD_EXPECT["DML101"]
+        for f in payload["findings"]:
+            assert set(f) == {"rule", "path", "line", "col", "message", "context"}
+            assert isinstance(f["line"], int) and f["line"] >= 1
+        # stable ordering: sorted by (path, line, col, rule)
+        keys = [(f["path"], f["line"], f["col"], f["rule"]) for f in payload["findings"]]
+        assert keys == sorted(keys)
+
+    def test_json_clean_exit_zero(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml101_clean.py"), "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"] == [] and payload["counts"] == {}
+
+    def test_human_output(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml103_bad.py")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "DML103" in out and "dml103_bad.py" in out
+        assert "3 finding(s)" in out
+
+    def test_list_rules(self, capsys):
+        assert lint_cli(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in BAD_EXPECT:
+            assert rule_id in out
+
+    def test_select_flag(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml101_bad.py"), "--select", "DML104", "--json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["findings"] == []
+
+    def test_unknown_rule_id_is_usage_error(self, capsys):
+        assert lint_cli([str(FIXTURES), "--select", "DML777"]) == 2
+
+
+class TestTraceGuard:
+    def test_flags_retrace_on_cpu(self):
+        import jax
+        import jax.numpy as jnp
+
+        guarded = TraceGuard(jax.jit(lambda x: x * 2), max_traces=1)
+        guarded(jnp.ones(3))
+        guarded(jnp.ones(3))  # same shape: cached, fine
+        assert guarded.cache_size() == 1
+        with pytest.raises(RetraceError, match="DML104"):
+            guarded(jnp.ones(4))  # new shape: retrace
+
+    def test_warn_mode_logs_once_per_growth(self, caplog):
+        import jax
+        import jax.numpy as jnp
+
+        guarded = TraceGuard(jax.jit(lambda x: x + 1), max_traces=1, action="warn", name="step")
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu.lint.traceguard"):
+            guarded(jnp.ones(2))
+            guarded(jnp.ones(3))
+            guarded(jnp.ones(3))  # no growth: no second warning
+        msgs = [r for r in caplog.records if "TraceGuard[step]" in r.getMessage()]
+        assert len(msgs) == 1
+
+    def test_shape_buckets_allowed_by_max_traces(self):
+        import jax
+        import jax.numpy as jnp
+
+        guarded = TraceGuard(jax.jit(lambda x: x.sum()), max_traces=2)
+        guarded(jnp.ones(2))
+        guarded(jnp.ones(4))  # second bucket: allowed
+        assert guarded.calls == 2
+
+    def test_unjitted_callable_passes_through(self):
+        guarded = TraceGuard(lambda x: x + 1, max_traces=1)
+        assert guarded(1) == 2 and guarded(2) == 3
+        assert guarded.cache_size() is None
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            TraceGuard(lambda x: x, action="explode")
+        with pytest.raises(ValueError):
+            TraceGuard(lambda x: x, max_traces=0)
+
+
+def _make_bad_stage_cls():
+    from dmlcloud_tpu import TrainValStage
+
+    class ItemHappyStage(TrainValStage):
+        def train_epoch(self):
+            for batch in self.ds:
+                self.state, metrics = self._train_step_fn(self.state, batch)
+                self.track_reduce("loss", metrics["loss"].item())
+
+    return ItemHappyStage
+
+
+class TestPipelineLintArm:
+    def test_error_mode_raises_before_any_device_work(self):
+        from dmlcloud_tpu import TrainingPipeline
+
+        pipeline = TrainingPipeline(lint="error")
+        pipeline.append_stage(_make_bad_stage_cls()(), max_epochs=1)
+        with pytest.raises(LintError, match="DML101") as exc:
+            pipeline.run()
+        assert exc.value.findings and exc.value.findings[0].rule == "DML101"
+
+    def test_warn_mode_logs_and_continues(self, caplog):
+        from dmlcloud_tpu import TrainingPipeline
+
+        pipeline = TrainingPipeline(lint="warn")
+        pipeline.append_stage(_make_bad_stage_cls()(), max_epochs=1)
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu"):
+            pipeline._lint_stages()
+        assert any("DML101" in r.getMessage() for r in caplog.records)
+
+    def test_clean_stage_passes_error_mode(self):
+        from dmlcloud_tpu import TrainingPipeline, TrainValStage
+
+        class FineStage(TrainValStage):
+            def step(self, state, batch):
+                return state.apply_fn(state.params, batch).mean()
+
+        pipeline = TrainingPipeline(lint="error")
+        pipeline.append_stage(FineStage(), max_epochs=1)
+        pipeline._lint_stages()  # no raise
+
+    def test_invalid_mode_rejected(self):
+        from dmlcloud_tpu import TrainingPipeline
+
+        with pytest.raises(ValueError):
+            TrainingPipeline(lint="maybe")

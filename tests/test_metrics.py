@@ -272,3 +272,45 @@ class TestMetricTracker:
         t = MetricTracker()
         t.register_metric("x")
         assert "x" in str(t)
+
+
+class TestPerTrackerInexactWarning:
+    """The inexact-SUM warning dedupe is per-tracker (a second pipeline or
+    test in the same process warns again), and the exactness check runs as
+    one vectorized pass over the already-packed vector."""
+
+    def test_warned_set_scopes_the_dedupe(self, caplog):
+        import logging
+
+        from dmlcloud_tpu.metrics import _pack_scalar_metrics
+
+        reds = {"big": Reduction.SUM}
+        local = {"big": (False, 2**24 + 1)}
+        first_tracker, second_tracker = set(), set()
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu.metrics"):
+            _pack_scalar_metrics(["big"], local, reds, warned=first_tracker)
+            _pack_scalar_metrics(["big"], local, reds, warned=first_tracker)  # deduped
+            _pack_scalar_metrics(["big"], local, reds, warned=second_tracker)  # warns again
+        msgs = [r for r in caplog.records if "exact" in r.getMessage()]
+        assert len(msgs) == 2
+        assert first_tracker == {"big"} and second_tracker == {"big"}
+
+    def test_each_tracker_owns_its_set(self):
+        t1, t2 = MetricTracker(), MetricTracker()
+        t1._inexact_sum_warned.add("big")
+        assert "big" not in t2._inexact_sum_warned
+
+    def test_packed_values_unchanged_by_hoisted_conversion(self):
+        """The one-pass conversion must produce the identical f32 payload
+        the per-element np.float32() casts did."""
+        from dmlcloud_tpu.metrics import _pack_scalar_metrics
+
+        names = ["a", "b", "c"]
+        local = {"a": (False, 1.5), "b": (True, None), "c": (False, 2**24 + 1)}
+        vec = _pack_scalar_metrics(names, local, warned=set())
+        n = len(names)
+        assert vec.dtype == np.float32
+        assert list(vec[1 : 1 + n]) == [0.0, 1.0, 0.0]
+        assert vec[1 + n] == np.float32(1.5)
+        assert vec[1 + n + 1] == np.float32(0.0)  # empty slot stays zero
+        assert vec[1 + n + 2] == np.float32(2**24 + 1)

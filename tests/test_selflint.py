@@ -1,0 +1,44 @@
+"""Self-lint gate (tier-1): the framework and its examples must satisfy the
+very contract the linter enforces — zero findings over ``dmlcloud_tpu/``
+and ``examples/``.
+
+This is the CI tripwire the lint subsystem exists for: a future Stage
+subclass, example, or hot-loop edit that reintroduces a host sync, an
+undonated train step, or a retrace hazard fails HERE, on CPU, at review
+time — not three PRs later on a chip. Legitimate exceptions carry a
+``# dmllint: disable=...`` with a justification (see stage.py's eager
+bisection path for the canonical one).
+"""
+
+from pathlib import Path
+
+import dmlcloud_tpu
+from dmlcloud_tpu.lint import lint_paths
+
+PACKAGE_DIR = Path(dmlcloud_tpu.__file__).resolve().parent
+REPO_ROOT = PACKAGE_DIR.parent
+
+
+def _report(findings):
+    return "\n".join(f.format() for f in findings)
+
+
+def test_package_lints_clean():
+    findings = lint_paths([PACKAGE_DIR])
+    assert findings == [], (
+        f"dmlcloud_tpu/ violates its own sync-point contract:\n{_report(findings)}\n"
+        "Fix the hazard or suppress it with '# dmllint: disable=ID -- why'."
+    )
+
+
+def test_examples_lint_clean():
+    examples = REPO_ROOT / "examples"
+    if not examples.is_dir():  # installed-package runs have no examples tree
+        import pytest
+
+        pytest.skip("examples/ not present next to the package")
+    findings = lint_paths([examples])
+    assert findings == [], (
+        f"examples/ violate the sync-point contract:\n{_report(findings)}\n"
+        "Examples are copied verbatim by users — they must model the contract."
+    )
