@@ -149,11 +149,14 @@ class ResNetBenchStage(dml.TrainValStage):
 
 
 def _instrument_stage(stage):
-    """Timer hook: start the clock once the warmup steps (incl. compile) have
-    fully executed on device; everything after is the measured tail. Returns
-    the list that receives [t_after_warmup, t_after_timed]."""
+    """Timer hook: marks completion of [first step, warmup tail, timed tail]
+    on device (the first two coincide when WARMUP_STEPS == 1). The last two
+    bracket the throughput window; the first, against the time ``run()`` was
+    entered, is the time-to-first-step — the startup tax every receipt now
+    records."""
     marks: list = []
     count = [0]
+    mark_at = {1, WARMUP_STEPS, WARMUP_STEPS + TIMED_STEPS}
     orig_build = stage._build_train_step
 
     def instrumented_build():
@@ -163,7 +166,7 @@ def _instrument_stage(stage):
         def wrapped(state, b):
             out = fn(state, b)
             count[0] += 1
-            if count[0] in (WARMUP_STEPS, WARMUP_STEPS + TIMED_STEPS):
+            if count[0] in mark_at:
                 float(out[1][loss_name])  # value fetch forces the whole chain
                 marks.append(time.perf_counter())
             return out
@@ -174,14 +177,18 @@ def _instrument_stage(stage):
     return marks
 
 
-def bench_framework(batch) -> float:
+def bench_framework(batch) -> dict:
     pipeline = dml.TrainingPipeline(name="bench-resnet50")
     stage = ResNetBenchStage(batch)
     pipeline.append_stage(stage, max_epochs=1)
     marks = _instrument_stage(stage)
+    t0 = time.perf_counter()
     pipeline.run()
     batch_size = int(batch["label"].shape[0])
-    return TIMED_STEPS * batch_size / (marks[1] - marks[0])
+    return {
+        "ips": TIMED_STEPS * batch_size / (marks[-1] - marks[-2]),
+        "time_to_first_step_s": marks[0] - t0,
+    }
 
 
 def _lm_model(s=1024, layers=12, vocab=32000, hidden=768, heads=12, kv=4, head_dim=64,
@@ -274,7 +281,7 @@ class LMBenchStage(dml.TrainValStage):
         pass
 
 
-def bench_lm_framework(b=8, s=1024, layers=12, vocab=32000) -> float:
+def bench_lm_framework(b=8, s=1024, layers=12, vocab=32000) -> dict:
     """Tokens/s of the same LM config as bench_lm, through the full
     framework path. vs bench_lm's raw loop == the framework overhead for
     transformer users."""
@@ -283,8 +290,12 @@ def bench_lm_framework(b=8, s=1024, layers=12, vocab=32000) -> float:
     stage = LMBenchStage(tokens, s, layers, vocab)
     pipeline.append_stage(stage, max_epochs=1)
     marks = _instrument_stage(stage)
+    t0 = time.perf_counter()
     pipeline.run()
-    return TIMED_STEPS * b * s / (marks[1] - marks[0])
+    return {
+        "tps": TIMED_STEPS * b * s / (marks[-1] - marks[-2]),
+        "time_to_first_step_s": marks[0] - t0,
+    }
 
 
 def bench_decode(b=8, prompt_len=128, new_tokens=512, layers=12, vocab=32000, reps=3):
@@ -686,6 +697,223 @@ def bench_overlap(timeout_s: int = 900) -> dict | None:
     return None
 
 
+#: Marker lines of the compile-bench (cold-start) results. The worker runs
+#: ONE cold-or-warm measurement; the child orchestrates workers + ragged A/B.
+_COMPILE_WORKER_MARKER = "COMPILE_WORKER_RESULTS "
+_COMPILE_MARKER = "COMPILE_BENCH_RESULTS "
+
+
+def compile_worker_main():
+    """One time-to-first-step measurement in THIS process (the persistent
+    compilation cache only proves itself across processes, so cold and warm
+    each get a fresh interpreter): a 3x1024-hidden MLP TrainValStage with
+    ``precompile=True`` and the compile cache at ``$DML_COMPILE_CACHE_DIR``.
+    Prints one marker line of JSON."""
+    jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ["DML_COMPILE_CACHE_DIR"]
+    smoke = bool(os.environ.get("DML_BENCH_SMOKE"))
+    steps, batch, hidden = (6, 16, 256) if smoke else (8, 32, 1024)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(64, 1).astype(np.float32)
+    xs = rng.randn(steps, batch, 64).astype(np.float32)
+    batches = [{"x": x, "y": x @ w_true} for x in xs]
+
+    class CompileBenchStage(dml.TrainValStage):
+        ttfs_mark = None
+
+        def pre_stage(self):
+            import flax.linen as nn
+
+            class MLP(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    for _ in range(3):
+                        x = jax.nn.relu(nn.Dense(hidden)(x))
+                    return nn.Dense(1)(x)
+
+            model = MLP()
+            self.pipeline.register_model(
+                "mlp", model, params=model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64))),
+                verbose=False,
+            )
+            self.pipeline.register_optimizer("adamw", optax.adamw(1e-3))
+            self.pipeline.register_dataset("train", batches, verbose=False)
+
+        def step(self, state, batch):
+            pred = state.apply_fn({"params": state.params}, batch["x"])
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        def val_epoch(self):  # startup-tax measurement: train only
+            pass
+
+        def train_epoch(self):
+            if self.ttfs_mark is None:
+                orig, loss_name = self._train_step_fn, self.loss_metric_name()
+
+                def first_step_marked(state, b):
+                    out = orig(state, b)
+                    if self.ttfs_mark is None:
+                        self._stall.fetch(out[1][loss_name])  # completion sync
+                        type(self).ttfs_mark = time.perf_counter()
+                    return out
+
+                self._train_step_fn = first_step_marked
+            super().train_epoch()
+
+    pipeline = dml.TrainingPipeline(
+        name="bench-compile", compile_cache=cache_dir, precompile=True
+    )
+    stage = CompileBenchStage()
+    pipeline.append_stage(stage, max_epochs=1)
+    t0 = time.perf_counter()
+    pipeline.run()
+    total = time.perf_counter() - t0
+
+    from dmlcloud_tpu.compile.cache import cache_stats
+
+    stats = cache_stats()
+    compile_ms = pipeline.tracker["misc/compile_ms"][0]
+    out = {
+        "time_to_first_step_s": round(CompileBenchStage.ttfs_mark - t0, 4),
+        "precompile_ms": round(float(compile_ms), 1) if compile_ms is not None else None,
+        "run_total_s": round(total, 4),
+        "cache_entries": stats["entries"],
+        "aot_hits": stats["aot_hits"],
+        "aot_misses": stats["aot_misses"],
+    }
+    print(_COMPILE_WORKER_MARKER + json.dumps(out), flush=True)
+
+
+def _run_compile_worker(cache_dir: str, timeout_s: int = 600) -> dict | None:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DML_COMPILE_CACHE_DIR"] = cache_dir
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--compile-worker"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_COMPILE_WORKER_MARKER):
+            try:
+                return json.loads(line[len(_COMPILE_WORKER_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
+def _ragged_config(buckets, sizes, epochs=2) -> dict:
+    """One ragged-batch run (in-process, CPU): linear regression over batches
+    of the given sizes, precompiled, with or without shape buckets. Returns
+    the compiled-signature count and the per-epoch mid-run compile count —
+    bounded by len(buckets) with bucketing, growing with the distinct sizes
+    without."""
+    from dmlcloud_tpu.compile import masked_mean
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(32, 1).astype(np.float32)
+    batches = []
+    for s in sizes:
+        x = rng.randn(s, 32).astype(np.float32)
+        batches.append({"x": x, "y": x @ w_true})
+
+    class RaggedStage(dml.TrainValStage):
+        def pre_stage(self):
+            self.pipeline.register_model(
+                "linear",
+                apply_fn=lambda p, x: x @ p["w"],
+                params={"w": jnp.zeros((32, 1))},
+                verbose=False,
+            )
+            self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
+            self.pipeline.register_dataset("train", batches, verbose=False)
+
+        def step(self, state, batch):
+            pred = state.apply_fn(state.params, batch["x"])
+            per_sample = jnp.sum((pred - batch["y"]) ** 2, axis=-1)
+            if "sample_mask" in batch:
+                return masked_mean(per_sample, batch["sample_mask"])
+            return jnp.mean(per_sample)
+
+        def val_epoch(self):
+            pass
+
+    pipeline = dml.TrainingPipeline(
+        name=f"bench-ragged-{'buckets' if buckets else 'none'}",
+        precompile=True,
+        buckets=buckets,
+    )
+    stage = RaggedStage()
+    pipeline.append_stage(stage, max_epochs=epochs)
+    pipeline.run()
+    return {
+        "bucket_set": list(buckets) if buckets else None,
+        "compiled_signatures": stage._train_compiled._cache_size(),
+        "recompiles_per_epoch": [int(x) for x in pipeline.tracker["misc/recompiles"]],
+    }
+
+
+def compile_child_main():
+    """The cold-start A/B, printed behind one marker line: (1) cold vs warm
+    persistent-cache time-to-first-step, each in a fresh worker process
+    sharing one cache dir; (2) ragged-batch signature growth with vs without
+    shape buckets (in-process)."""
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        cache_dir = os.path.join(td, "xla-cache")
+        out["cold"] = _run_compile_worker(cache_dir)
+        out["warm"] = _run_compile_worker(cache_dir)
+    cold, warm = out.get("cold") or {}, out.get("warm") or {}
+    if cold.get("time_to_first_step_s") and warm.get("time_to_first_step_s"):
+        out["warm_vs_cold_ttfs_ratio"] = round(
+            warm["time_to_first_step_s"] / cold["time_to_first_step_s"], 4
+        )
+    smoke = bool(os.environ.get("DML_BENCH_SMOKE"))
+    sizes = (16, 16, 10, 6, 16, 3) if smoke else (64, 64, 40, 24, 64, 64, 12, 64)
+    ragged_buckets = (8, 16) if smoke else (16, 32, 64)
+    out["ragged"] = {
+        "batch_sizes": list(sizes),
+        "no_buckets": _ragged_config(None, sizes),
+        "buckets": _ragged_config(ragged_buckets, sizes),
+    }
+    print(_COMPILE_MARKER + json.dumps(out), flush=True)
+
+
+def bench_compile(timeout_s: int = 1200) -> dict | None:
+    """Launch the cold-start A/B in a CPU-pinned child; returns its results
+    dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--compile-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_COMPILE_MARKER):
+            try:
+                return json.loads(line[len(_COMPILE_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 def _init_watchdog(timeout_s: int = None):
     """Fail fast when backend init hangs (wedged device tunnel): a daemon
     thread hard-exits with a clear stderr message unless the returned event
@@ -799,11 +1027,14 @@ def child_main():
             "best_batch": best_batch,
             "raw_ips": raw_by_batch[best_batch],
             "fw_ips": None,
+            "time_to_first_step_s": None,
         }
         # framework path is measured separately so a failure there still
         # leaves the raw ceiling recorded
         try:
-            out["fw_ips"] = bench_framework(synthetic_batch(np.random.RandomState(0), best_batch))
+            fw = bench_framework(synthetic_batch(np.random.RandomState(0), best_batch))
+            out["fw_ips"] = fw["ips"]
+            out["time_to_first_step_s"] = fw["time_to_first_step_s"]
         except Exception as e:
             errors.append(f"resnet_framework: {type(e).__name__}: {e}")
             print(f"child: framework bench failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -825,10 +1056,13 @@ def child_main():
         tps, mfu = by_batch[best_b]
         out = {
             "raw_tps": tps, "mfu": mfu, "fw_tps": None, "batch_size": best_b,
+            "time_to_first_step_s": None,
             "raw_tps_by_batch": {str(b): round(v[0], 1) for b, v in by_batch.items()},
         }
         try:  # framework path measured separately so raw numbers survive
-            out["fw_tps"] = bench_lm_framework(b=best_b, **lm_shape)
+            fw = bench_lm_framework(b=best_b, **lm_shape)
+            out["fw_tps"] = fw["tps"]
+            out["time_to_first_step_s"] = fw["time_to_first_step_s"]
         except Exception as e:
             errors.append(f"lm_framework: {type(e).__name__}: {e}")
             print(f"child: lm framework bench failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -973,6 +1207,11 @@ def main():
     except Exception as e:  # noqa: BLE001
         print(f"parent: overlap bench failed: {type(e).__name__}: {e}", file=sys.stderr)
         overlap = None
+    try:
+        compile_ab = bench_compile()
+    except Exception as e:  # noqa: BLE001
+        print(f"parent: compile bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        compile_ab = None
     tpu = _run_tpu_child() or {}
 
     peak = tpu.get("peak_flops") or 197e12
@@ -1006,6 +1245,7 @@ def main():
                     "lm_train_tokens_per_sec_by_batch": lm.get("raw_tps_by_batch"),
                     "lm_train_mfu": _rnd(lm.get("mfu"), 4),
                     "lm_framework_tokens_per_sec": _rnd(lm.get("fw_tps"), 1),
+                    "lm_framework_time_to_first_step_s": _rnd(lm.get("time_to_first_step_s"), 3),
                     "lm_vs_baseline": _rnd(
                         lm["fw_tps"] / lm["raw_tps"] if lm.get("fw_tps") and lm.get("raw_tps") else None, 4
                     ),
@@ -1052,6 +1292,26 @@ def main():
     extras["chunked_loss_ratio_vs_full"] = _rnd(
         chunked_tps / lm["raw_tps"] if chunked_tps and lm.get("raw_tps") else None, 4
     )
+    if compile_ab is not None:
+        cold, warm = compile_ab.get("cold") or {}, compile_ab.get("warm") or {}
+        ragged = compile_ab.get("ragged") or {}
+        nb, wb = ragged.get("no_buckets") or {}, ragged.get("buckets") or {}
+        extras.update(
+            {
+                "compile_cold_time_to_first_step_s": cold.get("time_to_first_step_s"),
+                "compile_warm_time_to_first_step_s": warm.get("time_to_first_step_s"),
+                "compile_warm_vs_cold_ttfs_ratio": compile_ab.get("warm_vs_cold_ttfs_ratio"),
+                "ragged_signatures_no_buckets": nb.get("compiled_signatures"),
+                "ragged_signatures_with_buckets": wb.get("compiled_signatures"),
+                "ragged_recompiles_per_epoch_no_buckets": nb.get("recompiles_per_epoch"),
+                "ragged_recompiles_per_epoch_with_buckets": wb.get("recompiles_per_epoch"),
+                "compile_bench_env": (
+                    "CPU child processes; cold/warm share one fresh persistent-cache "
+                    "dir, each measured in its own interpreter; ragged A/B in-process "
+                    "with precompile=True"
+                ),
+            }
+        )
     if overlap is not None:
         on, off = overlap.get("on") or {}, overlap.get("off") or {}
         extras.update(
@@ -1076,6 +1336,9 @@ def main():
                 "metric": "resnet50_images_per_sec_per_chip",
                 "value": _rnd(value, 2),
                 "unit": "images/s",
+                # first-class: the startup tax (framework ResNet path, run()
+                # entry -> first step executed), tracked across receipts
+                "time_to_first_step_s": _rnd(resnet.get("time_to_first_step_s"), 3),
                 "vs_baseline": _rnd(
                     fw_ips / raw_ips if fw_ips is not None and raw_ips is not None else None, 4
                 ),
@@ -1090,5 +1353,9 @@ if __name__ == "__main__":
         child_main()
     elif "--overlap-child" in sys.argv[1:]:
         overlap_child_main()
+    elif "--compile-child" in sys.argv[1:]:
+        compile_child_main()
+    elif "--compile-worker" in sys.argv[1:]:
+        compile_worker_main()
     else:
         main()

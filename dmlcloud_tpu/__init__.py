@@ -8,7 +8,7 @@ step instead of hook-driven allreduce, the jax.distributed coordination
 service instead of c10d rendezvous, and Orbax for sharded tensor state.
 """
 
-from . import data, lint, metrics, parallel, utils
+from . import compile, data, lint, metrics, parallel, utils
 from .checkpoint import CheckpointDir, find_slurm_checkpoint, generate_checkpoint_path
 from .metrics import MetricReducer, MetricTracker, Reduction
 from .pipeline import TrainingPipeline
@@ -18,6 +18,7 @@ from .train_state import TrainState
 __version__ = "0.5.0"
 
 __all__ = [
+    "compile",
     "data",
     "lint",
     "metrics",

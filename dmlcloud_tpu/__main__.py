@@ -35,14 +35,23 @@ def _diag_main(argv) -> int:
     import jax
 
     from . import __version__
+    from .compile.cache import cache_stats
     from .utils.logging import accelerator_info, general_diagnostics
 
+    cache = cache_stats()
     if not args.json:
         print(f"dmlcloud_tpu {__version__}")
         print(general_diagnostics())
+        state = (
+            f"{cache['entries']} entries, {cache['size_bytes'] / 1e6:.1f} MB"
+            if cache["enabled"]
+            else "disabled (TrainingPipeline(compile_cache=True) or $DMLCLOUD_COMPILE_CACHE_DIR)"
+        )
+        print(f"* COMPILE CACHE:\n    - dir: {cache['dir']}\n    - state: {state}")
         return 0
 
     info = {"version": __version__, "python": sys.version.split()[0], "jax": jax.__version__}
+    info["compile_cache"] = cache
     info.update(accelerator_info())  # {"error": ...} when backend init fails
     print(json.dumps(info))
     return 1 if "error" in info else 0

@@ -159,6 +159,11 @@ class CheckpointDir:
         self.path = as_run_path(path)
         self._state_managers: dict[str | None, Any] = {}
         self._manager_opts: dict[str | None, tuple] = {}
+        #: scope -> shim preservation policy evaluated host-side (old orbax
+        #: without the preservation-policy API; utils/orbax_compat.py)
+        self._retention_policies: dict[str | None, Any] = {}
+        #: scope -> {step: metrics dict} backing the shim BestN ranking
+        self._policy_metrics: dict[str | None, dict[int, dict]] = {}
 
     # -- contract files -----------------------------------------------------
     @property
@@ -251,14 +256,29 @@ class CheckpointDir:
             return self._state_managers[scope]
         import orbax.checkpoint as ocp
 
+        from .utils import orbax_compat
+
+        # old orbax has no preservation_policy option: strip it, remember it,
+        # and apply the retention ourselves after each save (identical keep
+        # semantics, host-side). ``requested`` above already includes the
+        # policy, so the changed-options guard behaves the same either way.
+        orbax_options = dict(options)
+        shim_policy = orbax_options.get("preservation_policy")
+        if orbax_compat.is_shim_policy(shim_policy):
+            orbax_options.pop("preservation_policy")
+        else:
+            shim_policy = None
+
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=requested[0],
             enable_async_checkpointing=requested[1],
-            **options,
+            **orbax_options,
         )
         root = self.state_dir / scope if scope else self.state_dir
         self._state_managers[scope] = ocp.CheckpointManager(root, options=opts)
         self._manager_opts[scope] = requested
+        if shim_policy is not None:
+            self._retention_policies[scope] = shim_policy
         return self._state_managers[scope]
 
     def save_state(self, step: int, state: Any, scope: str | None = None, **kwargs) -> None:
@@ -266,6 +286,46 @@ class CheckpointDir:
         import orbax.checkpoint as ocp
 
         self.state_manager(scope).save(step, args=ocp.args.StandardSave(state), **kwargs)
+        if scope in self._retention_policies:
+            self._apply_retention(scope, step, kwargs.get("metrics"))
+
+    # -- host-side retention (old orbax; utils/orbax_compat.py) -------------
+    def _policy_metrics_file(self, scope: str | None) -> epath.Path:
+        # under meta/ (not state/) so orbax's step scan never sees it; the
+        # non-digit stem survives the stage's sidecar retention cleanup
+        return self.path / "meta" / (scope or "_root") / "_policy_metrics.json"
+
+    def _apply_retention(self, scope: str | None, step: int, metrics: Any) -> None:
+        """Evaluate the shim preservation policy after a save and delete the
+        steps it does not keep. Every process computes the same keep set (the
+        metrics kwarg is identical across ranks); orbax's ``delete`` does the
+        actual (primary-host) filesystem work. Rankings persist across
+        restarts via a root-written JSON sidecar."""
+        import json
+
+        import jax
+
+        from .utils import orbax_compat
+
+        known = self._policy_metrics.setdefault(scope, {})
+        if not known:
+            try:
+                raw = json.loads(self._policy_metrics_file(scope).read_text())
+                known.update({int(k): v for k, v in raw.items()})
+            except Exception:
+                pass  # fresh run dir, or pre-shim checkpoints: rank what we have
+        if metrics is not None:
+            known[int(step)] = metrics
+        mgr = self._state_managers[scope]
+        steps = set(int(s) for s in mgr.all_steps()) | {int(step)}
+        keep = orbax_compat.steps_to_keep(self._retention_policies[scope], steps, known)
+        for old in sorted(steps - keep):
+            mgr.delete(old)
+            known.pop(old, None)
+        if jax.process_index() == 0:
+            meta_file = self._policy_metrics_file(scope)
+            meta_file.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(meta_file, json.dumps({str(k): v for k, v in known.items()}))
 
     def restore_state(self, step: int | None = None, template: Any = None, scope: str | None = None) -> Any:
         """Restore the latest (or a given) step; with ``template``, arrays are
@@ -304,6 +364,8 @@ class CheckpointDir:
             mgr.close()
         self._state_managers = {}
         self._manager_opts = {}
+        self._retention_policies = {}
+        self._policy_metrics = {}
 
     def __str__(self) -> str:
         return str(self.path)

@@ -1,0 +1,181 @@
+"""Shape bucketing: bound the compiled-signature set under ragged batches.
+
+Every distinct batch shape that reaches a jitted step is a full XLA
+compile. Real data is ragged — a partial last batch every epoch, variable
+sequence lengths — so without intervention the signature count grows with
+the data, and each growth event is a mid-run compile stall (the hazard
+DML104/TraceGuard flags but cannot prevent). Bucketing prevents it: pad the
+ragged dim up to the smallest member of a small, fixed bucket set, so the
+step only ever sees ``len(buckets)`` signatures — all of which the AOT
+precompiler can compile before the loop.
+
+Padding must not change the math. For mapping batches ``pad_to_bucket``
+injects a ``sample_mask`` leaf (1.0 for real rows, 0.0 for padding); a step
+that reduces its per-sample loss with :func:`masked_mean` (or counts with
+:func:`masked_sum`) produces losses, metrics, AND gradients identical to
+the unpadded batch — padded rows multiply everything they touch by zero.
+Non-mapping batches are padded without a mask (there is nowhere to put
+one); masking is then the step's own responsibility, and a one-time warning
+says so.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Iterable, Iterator, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DEFAULT_MASK_KEY",
+    "bucket_for",
+    "bucket_iterator",
+    "bucket_spec",
+    "masked_mean",
+    "masked_sum",
+    "pad_to_bucket",
+    "resolve_buckets",
+]
+
+_logger = logging.getLogger("dmlcloud_tpu")
+
+DEFAULT_MASK_KEY = "sample_mask"
+
+
+def resolve_buckets(buckets: Iterable[int]) -> tuple[int, ...]:
+    """Normalise a bucket set: ints, deduplicated, ascending, all positive.
+    Include your full batch size as the largest bucket — batches above it
+    are an error, not a silent extra signature."""
+    sizes = sorted({int(b) for b in buckets})
+    if not sizes:
+        raise ValueError("buckets must contain at least one size")
+    if sizes[0] <= 0:
+        raise ValueError(f"bucket sizes must be positive, got {sizes[0]}")
+    return tuple(sizes)
+
+
+def bucket_for(size: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= ``size``."""
+    for b in buckets:
+        if b >= size:
+            return int(b)
+    raise ValueError(
+        f"batch size {size} exceeds the largest bucket {buckets[-1]}; include the "
+        "full batch size in the bucket set"
+    )
+
+
+def _pad_leaf(x: Any, pad: int, axis: int):
+    if pad == 0:
+        return x
+    ndim = getattr(x, "ndim", 0)
+    if ndim <= axis:
+        return x  # scalars / low-rank leaves carry no batch dim to pad
+    widths = [(0, 0)] * ndim
+    widths[axis] = (0, pad)
+    if isinstance(x, jax.Array):
+        return jnp.pad(x, widths)
+    return np.pad(np.asarray(x), widths)
+
+
+def pad_to_bucket(
+    batch: Any,
+    buckets: Sequence[int],
+    axis: int = 0,
+    mask_key: str = DEFAULT_MASK_KEY,
+) -> Any:
+    """Pad ``batch``'s ``axis`` dim (zeros at the end) up to its bucket.
+
+    Mapping batches come back as a dict with a float32 ``mask_key`` leaf of
+    length ``bucket`` (1.0 real / 0.0 padded); a pre-existing ``mask_key``
+    leaf is respected — padded with zeros like any other leaf, never
+    overwritten (its padding rows are zero-weight either way). Other batch
+    pytrees are padded in place with no mask."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    sizes = {leaf.shape[axis] for leaf in leaves if getattr(leaf, "ndim", 0) > axis}
+    if not sizes:
+        return batch
+    if len(sizes) > 1:
+        raise ValueError(
+            f"batch leaves disagree on the size of dim {axis} ({sorted(sizes)}); "
+            "bucketing pads one consistent batch dim"
+        )
+    size = sizes.pop()
+    bucket = bucket_for(size, buckets)
+    pad = bucket - size
+    padded = jax.tree_util.tree_map(lambda x: _pad_leaf(x, pad, axis), batch)
+    if isinstance(batch, Mapping):
+        padded = dict(padded)
+        if mask_key not in padded:
+            mask = np.zeros(bucket, np.float32)
+            mask[:size] = 1.0
+            padded[mask_key] = mask
+    return padded
+
+
+def bucket_spec(spec: Any, bucket: int, axis: int = 0, mask_key: str = DEFAULT_MASK_KEY) -> Any:
+    """The abstract (``ShapeDtypeStruct``) batch a bucket produces: every
+    batched leaf's ``axis`` dim set to ``bucket``, plus the mask leaf for
+    mapping specs — what the AOT precompiler lowers against, one per
+    bucket."""
+
+    def leaf(s):
+        shape = list(s.shape)
+        if len(shape) > axis:
+            shape[axis] = int(bucket)
+        return jax.ShapeDtypeStruct(tuple(shape), s.dtype)
+
+    out = jax.tree_util.tree_map(leaf, spec)
+    if isinstance(spec, Mapping):
+        out = dict(out)
+        if mask_key not in out:
+            out[mask_key] = jax.ShapeDtypeStruct((int(bucket),), np.float32)
+    return out
+
+
+def bucket_iterator(
+    it: Iterable[Any],
+    buckets: Iterable[int],
+    axis: int = 0,
+    mask_key: str = DEFAULT_MASK_KEY,
+) -> Iterator[Any]:
+    """Wrap a host-batch iterator so every yielded batch is bucket-padded
+    (mapping batches gain the mask leaf). Sits BEFORE the device transfer in
+    the feeding path, so the device only ever sees bucket shapes."""
+    buckets = resolve_buckets(buckets)
+    warned = False
+    for batch in it:
+        if not warned and not isinstance(batch, Mapping):
+            warned = True
+            _logger.warning(
+                "bucketing a non-mapping batch (%s): rows are padded but no mask "
+                "leaf can be injected — the step must zero-weight padded rows "
+                "itself or the loss is diluted",
+                type(batch).__name__,
+            )
+        yield pad_to_bucket(batch, buckets, axis=axis, mask_key=mask_key)
+
+
+def masked_mean(values: Any, mask: Any):
+    """Mean of ``values`` over REAL rows only: ``values`` is ``[B, ...]``,
+    ``mask`` is ``[B]`` (1.0 real / 0.0 padded). Padded rows contribute
+    exactly zero to the value and to its gradients; the divisor is the real
+    element count, so the result equals the plain mean of the unpadded
+    batch."""
+    values = jnp.asarray(values)
+    mask = jnp.asarray(mask, values.dtype)
+    mb = mask.reshape(mask.shape + (1,) * (values.ndim - mask.ndim))
+    per_row = math.prod(values.shape[mask.ndim:]) if values.ndim > mask.ndim else 1
+    denom = jnp.maximum(jnp.sum(mask), 1.0) * per_row
+    return jnp.sum(values * mb) / denom
+
+
+def masked_sum(values: Any, mask: Any):
+    """Sum of ``values`` over real rows only (counters, token totals)."""
+    values = jnp.asarray(values)
+    mask = jnp.asarray(mask, values.dtype)
+    mb = mask.reshape(mask.shape + (1,) * (values.ndim - mask.ndim))
+    return jnp.sum(values * mb)

@@ -1,0 +1,181 @@
+"""Persistent XLA compilation cache: wiring, stats, and AOT hit accounting.
+
+Every fresh process pays the full XLA compile bill at step 1 unless the
+compiled executable can be fetched from somewhere — jax's persistent
+compilation cache is that somewhere: a content-addressed directory of
+serialized executables, safe for concurrent writers (each entry is written
+once under a hash key), which makes it exactly right for a shared
+filesystem on a multi-host pod: every host points at the same directory and
+the first job to compile pays for everyone.
+
+``configure_cache`` is the one entry point (called by
+``TrainingPipeline(compile_cache=...)`` before any compilation, or directly
+at program start). Resolution order for the directory:
+
+1. an explicit path argument,
+2. ``$DMLCLOUD_COMPILE_CACHE_DIR``,
+3. whatever ``jax_compilation_cache_dir`` is already configured to,
+4. ``~/.cache/dmlcloud_tpu/xla``.
+
+Stats are two-layered: ``cache_stats()`` reports the on-disk population
+(entries/bytes — shared across every process using the dir) plus this
+process's AOT-phase counters (hits = programs the precompiler loaded from
+the cache, misses = programs it had to compile). On shared filesystems only
+process 0 should log them (``TrainingPipeline`` does).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+import jax
+
+__all__ = [
+    "ENV_VAR",
+    "DEFAULT_CACHE_DIR",
+    "configure_cache",
+    "resolve_cache_dir",
+    "configured_cache_dir",
+    "entry_count",
+    "record_compile",
+    "cache_stats",
+    "reset_process_stats",
+]
+
+ENV_VAR = "DMLCLOUD_COMPILE_CACHE_DIR"
+DEFAULT_CACHE_DIR = "~/.cache/dmlcloud_tpu/xla"
+
+_lock = threading.Lock()
+_aot_hits = 0
+_aot_misses = 0
+_aot_compile_ms = 0.0
+
+
+def configured_cache_dir() -> str | None:
+    """The directory jax's persistent cache currently writes to, or None."""
+    value = getattr(jax.config, "jax_compilation_cache_dir", None)
+    return value or None
+
+
+def resolve_cache_dir(cache_dir: Any = True) -> str | None:
+    """Resolve the cache directory per the module docstring's order without
+    touching jax config. ``None``/``False`` disables (returns None)."""
+    if cache_dir in (None, False):
+        return None
+    if isinstance(cache_dir, (str, os.PathLike)):
+        chosen = os.fspath(cache_dir)
+    else:  # True / anything truthy: env var, existing config, default
+        chosen = os.environ.get(ENV_VAR) or configured_cache_dir() or DEFAULT_CACHE_DIR
+    return os.path.abspath(os.path.expanduser(chosen))
+
+
+def configure_cache(cache_dir: Any = True, aggressive: bool = True) -> str | None:
+    """Point jax's persistent compilation cache at ``cache_dir`` (resolved as
+    above), creating the directory. Must run before the first compilation of
+    the programs it should cover. Returns the resolved directory (None when
+    disabled).
+
+    ``aggressive`` (default) also drops jax's minimum-compile-time /
+    minimum-entry-size thresholds so every program is persisted — the right
+    trade for training jobs, where a cache entry costs kilobytes and a cold
+    recompile costs seconds to minutes. Flags missing on older jax are
+    skipped silently (the cache still works, with jax's own thresholds)."""
+    resolved = resolve_cache_dir(cache_dir)
+    if resolved is None:
+        return None
+    os.makedirs(resolved, exist_ok=True)
+    previous = configured_cache_dir()
+    jax.config.update("jax_compilation_cache_dir", resolved)
+    if previous != resolved:
+        # jax latches the cache backend on the FIRST compilation of the
+        # process; if anything compiled before this call (it usually has —
+        # even an import-time jnp op), the new dir is ignored until the
+        # latched state is dropped. Private API, so best-effort by version.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    if aggressive:
+        for flag, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(flag, value)
+            except (AttributeError, ValueError):
+                pass
+    return resolved
+
+
+def _entry_files(directory: str) -> list[str]:
+    # jax writes `<key>-cache` payloads (some versions add `<key>-atime`
+    # bookkeeping files and tmp files mid-write; neither is an entry)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return [
+        os.path.join(directory, n)
+        for n in names
+        if not n.endswith("-atime") and not n.endswith(".tmp") and not n.startswith(".")
+    ]
+
+
+def entry_count(directory: str | None = None) -> int | None:
+    """Number of persisted executables in the cache dir (None if disabled)."""
+    directory = directory or configured_cache_dir()
+    if directory is None:
+        return None
+    return len(_entry_files(directory))
+
+
+def record_compile(hit: bool, elapsed_ms: float) -> None:
+    """Account one AOT-phase compilation for this process's stats."""
+    global _aot_hits, _aot_misses, _aot_compile_ms
+    with _lock:
+        if hit:
+            _aot_hits += 1
+        else:
+            _aot_misses += 1
+        _aot_compile_ms += float(elapsed_ms)
+
+
+def reset_process_stats() -> None:
+    global _aot_hits, _aot_misses, _aot_compile_ms
+    with _lock:
+        _aot_hits = _aot_misses = 0
+        _aot_compile_ms = 0.0
+
+
+def cache_stats() -> dict:
+    """On-disk population + this process's AOT counters, JSON-encodable.
+
+    When the cache is not enabled yet, ``dir`` still reports what
+    ``configure_cache(True)`` *would* use (env var or default) so ``diag``
+    shows an actionable path either way."""
+    enabled_dir = configured_cache_dir()
+    directory = enabled_dir or resolve_cache_dir(True)
+    entries = size = 0
+    if enabled_dir and os.path.isdir(enabled_dir):
+        files = _entry_files(enabled_dir)
+        entries = len(files)
+        for f in files:
+            try:
+                size += os.path.getsize(f)
+            except OSError:
+                pass
+    with _lock:
+        hits, misses, ms = _aot_hits, _aot_misses, _aot_compile_ms
+    return {
+        "enabled": enabled_dir is not None,
+        "dir": directory,
+        "entries": entries,
+        "size_bytes": size,
+        "aot_hits": hits,
+        "aot_misses": misses,
+        "aot_compile_ms": round(ms, 3),
+    }

@@ -21,11 +21,35 @@ Two overlap stages, both optional:
 from __future__ import annotations
 
 import collections
+import itertools
 from typing import Any, Iterable, Iterator
 
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+
+
+def peek_spec(it: Iterable[Any]) -> tuple[Any, Iterable[Any]]:
+    """Abstract spec (``ShapeDtypeStruct`` pytree) of the first batch,
+    WITHOUT consuming it: returns ``(spec, iterable)`` where the iterable
+    still yields every batch including the peeked one.
+
+    Re-iterable sources (lists, ``DataPipeline``\\ s — anything whose
+    ``iter()`` returns a fresh iterator) come back untouched; one-shot
+    iterators come back as a chain that replays the peeked batch first. The
+    AOT precompiler (compile/aot.py) uses this to derive the batch signature
+    at stage start when no ``batch_spec()`` is declared."""
+    from ..compile.aot import abstract_spec
+
+    src = iter(it)
+    try:
+        first = next(src)
+    except StopIteration:
+        raise ValueError("cannot peek the batch spec of an empty dataset") from None
+    spec = abstract_spec(first)
+    if src is it:  # one-shot iterator: replay the consumed batch
+        return spec, itertools.chain([first], src)
+    return spec, it
 
 
 def device_iterator(
@@ -50,6 +74,13 @@ def device_iterator(
     else:
         src = iter(it)
 
+    if prefetch <= 0:
+        # strictly synchronous: one transfer per consumed batch, nothing
+        # pulled from the source (or put on device) ahead of the step
+        for batch in src:
+            yield mesh_lib.make_global_batch(batch, mesh, pspec)
+        return
+
     def enqueue(n: int) -> None:
         for _ in range(n):
             try:
@@ -58,7 +89,7 @@ def device_iterator(
                 return
             queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
 
-    enqueue(max(prefetch, 1))
+    enqueue(prefetch)
     while queue:
         yield queue.popleft()
         enqueue(1)

@@ -1,4 +1,4 @@
-"""The TPU-hazard rules (DML101-DML106).
+"""The TPU-hazard rules (DML101-DML107).
 
 Each rule enforces one clause of the overlap engine's sync-point contract
 (doc/performance.md §3, doc/lint.md for the full catalog with examples):
@@ -9,6 +9,7 @@ Each rule enforces one clause of the overlap engine's sync-point contract
 - DML104  retrace/unroll hazards in a jitted step fn
 - DML105  blocking checkpoint/wandb calls inside the epoch loop
 - DML106  wall-clock timing of async dispatches without a device sync
+- DML107  jax.jit / pjit call inside a loop body (defeats the jit cache)
 
 Rules yield raw findings; the engine applies suppressions and sorting.
 """
@@ -229,6 +230,14 @@ def _hazardous_test(node: ast.AST, tainted: set[str], ctx: ModuleCtx) -> bool:
         operands = [node.left, *node.comparators]
         if any(isinstance(o, ast.Constant) and o.value is None for o in operands):
             return False
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+    ):
+        # '"mask" in batch': pytree STRUCTURE is static under trace, so key
+        # membership branches once at trace time — the idiom masked/bucketed
+        # steps use (compile/buckets.py)
+        if isinstance(node.left, ast.Constant) and isinstance(node.left.value, str):
+            return False
     if isinstance(node, ast.Call):
         fname = (ctx.resolve(node.func) or "").split(".")[-1]
         if fname in _TRACE_SAFE_CALLS:
@@ -374,3 +383,51 @@ def check_dishonest_timing(ctx: ModuleCtx):
                 "jax.block_until_ready(result) before reading the clock",
                 node.name,
             )
+
+
+# ------------------------------------------------------------------- DML107
+
+
+@rule("DML107", "jax.jit/pjit call inside a loop body")
+def check_jit_in_loop(ctx: ModuleCtx):
+    """``jax.jit(...)`` (or ``pjit`` / ``partial(jax.jit, ...)`` / a
+    ``@jax.jit``-decorated ``def``) executed inside a ``for``/``while`` body
+    creates a FRESH jitted callable every iteration — each one starts with
+    an empty compilation cache, so every iteration re-traces and re-compiles
+    work the previous iteration already paid for (the persistent cache can
+    soften the XLA half, never the trace half). Hoist the ``jit`` out of the
+    loop (or precompile it: compile/aot.py). Bodies of functions *defined*
+    inside the loop run at call time, not per iteration, and are skipped."""
+
+    def visit(node: ast.AST, in_loop: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if in_loop:
+                    for dec in child.decorator_list:
+                        if ctx._jit_kwargs(dec) is not None:
+                            yield _f(
+                                ctx, "DML107", dec,
+                                f"@jit-decorated def {child.name!r} inside a loop "
+                                "body re-jits (and re-compiles) every iteration; "
+                                "define it once before the loop",
+                                child.name,
+                            )
+                # the nested body executes when called, not per iteration
+                yield from visit(child, False)
+                continue
+            if isinstance(child, ast.Lambda):
+                yield from visit(child, False)
+                continue
+            if in_loop and isinstance(child, ast.Call) and ctx._jit_call_kwargs(child) is not None:
+                yield _f(
+                    ctx, "DML107", child,
+                    "jax.jit/pjit call inside a loop body builds a fresh jitted "
+                    "function (empty cache) every iteration — every step re-traces "
+                    "and re-compiles; hoist the jit out of the loop",
+                    "",
+                )
+            yield from visit(
+                child, in_loop or isinstance(child, (ast.For, ast.AsyncFor, ast.While))
+            )
+
+    yield from visit(ctx.tree, False)

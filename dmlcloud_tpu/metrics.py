@@ -373,6 +373,16 @@ class MetricTracker:
         else:
             self.histories[name].append(jax.device_get(value))
 
+    def bump(self, name: str, value: int | float = 1, globally: bool = True) -> None:
+        """Epoch-scoped event counter: register-on-first-use as a SUM
+        reduction and add ``value``. The idiom for counts where MEAN would
+        be meaningless (recompiles, skipped batches, retries); with
+        ``globally`` the epoch total sums across processes in the fused
+        exchange. Safe to call any number of times per epoch."""
+        if name not in self:
+            self.register_metric(name, Reduction.SUM, globally=globally)
+        self.track(name, value)
+
     def reduce_all(self, prefix: str | None = None, strict: bool = True) -> None:
         """Reduce all (or prefix-filtered) metrics and append to histories.
 

@@ -51,18 +51,45 @@ class ModelEntry:
 
 
 class TrainingPipeline:
-    def __init__(self, config: Any = None, name: Optional[str] = None, lint: Optional[str] = None):
+    def __init__(
+        self,
+        config: Any = None,
+        name: Optional[str] = None,
+        lint: Optional[str] = None,
+        compile_cache: Any = None,
+        precompile: bool = False,
+        buckets: Any = None,
+    ):
         """``lint`` arms the TPU-hazard linter (dmlcloud_tpu.lint) over every
         registered Stage subclass's source at run start: ``"warn"`` logs the
         findings, ``"error"`` raises ``lint.LintError`` before any device
         work happens. None (default) skips linting — the CLI
         (``python -m dmlcloud_tpu lint``) and the self-lint test remain the
-        review-time nets."""
+        review-time nets.
+
+        The cold-start killers (dmlcloud_tpu.compile; doc/performance.md §4):
+
+        - ``compile_cache``: persistent XLA compilation cache. ``True`` uses
+          ``$DMLCLOUD_COMPILE_CACHE_DIR`` (default
+          ``~/.cache/dmlcloud_tpu/xla``); a path selects the directory —
+          point every host of a pod at the same shared-FS dir (entries are
+          content-addressed; concurrent writers are safe; only process 0
+          logs stats). None (default) leaves jax's config untouched.
+        - ``precompile``: default for ``Stage.precompile()`` — AOT-compile
+          the train/val steps at stage start against the first batch's
+          abstract spec, before the data loop.
+        - ``buckets``: default for ``Stage.buckets()`` — pad ragged batch
+          dims to this ascending size set (with a zero-weight sample mask)
+          so the compiled-signature count stays bounded."""
         if lint not in (None, "warn", "error"):
             raise ValueError(f'lint must be None, "warn" or "error", got {lint!r}')
         self.config: Config = as_config(config)
         self.name = name
         self._lint_mode = lint
+        self._compile_cache = compile_cache
+        self._compile_cache_dir: str | None = None
+        self._precompile = bool(precompile)
+        self._buckets = tuple(buckets) if buckets else None
 
         self.logger = logging.getLogger("dmlcloud_tpu")
         self.checkpoint_dir: CheckpointDir | None = None
@@ -476,6 +503,12 @@ class TrainingPipeline:
         if len(self.stages) == 0:
             raise ValueError("No stages defined. Use append_stage() to add stages to the pipeline.")
         self._lint_stages()
+        if self._compile_cache not in (None, False):
+            # before ANY compilation (incl. the collectives the runtime
+            # bootstrap below may compile) so every program is cacheable
+            from .compile.cache import configure_cache
+
+            self._compile_cache_dir = configure_cache(self._compile_cache)
         if not runtime.is_initialized():
             runtime.init_auto()
 
@@ -523,6 +556,8 @@ class TrainingPipeline:
         diagnostics += "\n* CONFIG:\n"
         diagnostics += "\n".join(f"    {line}" for line in self.config.to_yaml(resolve=True).splitlines())
         self.logger.info(diagnostics)
+        if self._compile_cache_dir is not None and runtime.is_root():
+            self.logger.info("persistent compilation cache: %s", self._compile_cache_dir)
 
         self.pre_run()
 
@@ -542,6 +577,17 @@ class TrainingPipeline:
         self.stop_time = datetime.now()
         if self.checkpoint_dir is not None:
             self.checkpoint_dir.wait_until_finished()
+        # shared-FS aware: every process shares the cache dir, process 0 logs
+        if self._compile_cache_dir is not None and runtime.is_root():
+            from .compile.cache import cache_stats
+
+            s = cache_stats()
+            self.logger.info(
+                "compile cache: %d entries (%.1f MB) at %s — this process: "
+                "%d AOT hit(s), %d miss(es), %.0f ms compiling",
+                s["entries"], s["size_bytes"] / 1e6, s["dir"],
+                s["aot_hits"], s["aot_misses"], s["aot_compile_ms"],
+            )
         self.logger.info(f"Finished training in {self.stop_time - self.start_time} ({self.stop_time})")
         if self.checkpointing_enabled:
             self.logger.info(f"Outputs have been saved to {self.checkpoint_dir}")

@@ -314,6 +314,12 @@ class TrainValStage(Stage):
         #: or on checkpoint commits; reset per epoch, published as
         #: ``misc/host_stall_ms``
         self._stall = StallTimer()
+        #: cold-start machinery (compile/): signature registries wrapping the
+        #: jitted steps when precompile()/buckets() are armed, else None —
+        #: the default path keeps the raw jit fns with zero added overhead
+        self._train_compiled = None
+        self._val_compiled = None
+        self._buckets_resolved: tuple[int, ...] | None = None
         #: True exactly while the per-batch body of train_epoch runs — the
         #: window in which NO device readback may happen under
         #: ``deferred_metrics()`` (tests assert against it)
@@ -426,6 +432,45 @@ class TrainValStage(Stage):
         prep on the training thread — raise it when prep (augmentation,
         decode, disk reads) is a measurable share of the step budget."""
         return 0
+
+    def precompile(self) -> bool:
+        """Whether to AOT-compile the train/val steps at stage start (the
+        ``jit(...).lower(...).compile()`` pattern over abstract
+        ``ShapeDtypeStruct``\\ s, compile/aot.py): compile cost lands in a
+        timed precompile phase BEFORE the data loop (``misc/compile_ms``),
+        and sharding/shape mismatches error at stage start instead of
+        step 1. The batch signature comes from ``batch_spec()`` or, by
+        default, from peeking the first batch's shapes/dtypes (one
+        signature per bucket when ``buckets()`` is set). Default: the
+        pipeline's ``precompile=`` flag (False)."""
+        return bool(getattr(self.pipeline, "_precompile", False))
+
+    def buckets(self):
+        """Batch-dim bucket sizes for ragged batches, ascending (e.g.
+        ``(8, 32, 128)`` with 128 the full batch size), or None to disable.
+        Every host batch is padded up to the smallest fitting bucket before
+        the device transfer — mapping batches gain a zero-weight
+        ``bucket_mask_key()`` leaf (reduce per-sample losses with
+        ``compile.masked_mean`` to keep the math identical) — so the
+        compiled-signature count is bounded by ``len(buckets)`` instead of
+        growing with the data (``misc/recompiles`` tracks growth events per
+        epoch). Default: the pipeline's ``buckets=`` flag (None)."""
+        return getattr(self.pipeline, "_buckets", None)
+
+    def bucket_mask_key(self) -> str:
+        """Key under which bucketing injects the padding mask into mapping
+        batches (1.0 real row / 0.0 padded row)."""
+        from .compile.buckets import DEFAULT_MASK_KEY
+
+        return DEFAULT_MASK_KEY
+
+    def batch_spec(self):
+        """Declared abstract spec of one HOST train batch (a pytree of
+        ``jax.ShapeDtypeStruct`` — or of example arrays — matching what the
+        train dataset yields, pre-sharding). None (default) peeks the first
+        batch instead; declare it when the dataset is a one-shot iterator or
+        when you want stage-start validation against an explicit contract."""
+        return None
 
     def async_checkpoint(self) -> bool:
         """Whether this stage's Orbax scopes commit saves on a background
@@ -697,7 +742,9 @@ class TrainValStage(Stage):
             mode = self.checkpoint_best_mode()
             if mode not in ("min", "max"):
                 raise ValueError(f"checkpoint_best_mode() must be 'min' or 'max', got {mode!r}")
-            from orbax.checkpoint import checkpoint_managers as ocm
+            # via the compat layer: new orbax passes the policy through, old
+            # orbax (no checkpoint_managers module) gets host-side retention
+            from .utils import orbax_compat as ocm
 
             # best-N by the metric PLUS always the newest (deterministic
             # requeue-resume freshness; best_fn+max_to_keep alone leaves the
@@ -740,6 +787,121 @@ class TrainValStage(Stage):
             self._restore_state()
         self._train_step_fn = self._build_train_step()
         self._val_step_fn = self._build_val_step()
+        self._setup_compiled_steps()
+
+    # -- cold-start machinery (compile/; doc/performance.md §4) -------------
+    def _setup_compiled_steps(self):
+        """Arm the signature registries and (optionally) the AOT precompile
+        phase. Inactive (raw jit fns, zero added per-step cost) unless
+        ``precompile()`` or ``buckets()`` says otherwise."""
+        raw_buckets = self.buckets()
+        if raw_buckets:
+            from .compile.buckets import resolve_buckets
+
+            self._buckets_resolved = resolve_buckets(raw_buckets)
+        else:
+            self._buckets_resolved = None
+        if not self.precompile() and self._buckets_resolved is None:
+            return
+        from .compile.aot import PrecompiledStep
+        from .lint import TraceGuard
+
+        self._train_compiled = PrecompiledStep(self._train_step_fn, name=f"{self.name}.train_step")
+        self._val_compiled = PrecompiledStep(self._val_step_fn, name=f"{self.name}.val_step")
+        if self.precompile():
+            self._run_precompile_phase()
+        # the runtime retrace guard reads the registry's _cache_size(): any
+        # signature beyond the expected bucket set is a mid-run compile stall
+        expected = len(self._buckets_resolved) if self._buckets_resolved else 1
+        self._train_step_fn = TraceGuard(
+            self._train_compiled, max_traces=expected, action="warn", name=f"{self.name}.train_step"
+        )
+        self._val_step_fn = self._val_compiled
+
+    def _host_batch_spec(self, dataset_fn) -> Any:
+        """The abstract HOST batch for precompilation: ``batch_spec()`` if
+        declared (train only), else the peeked first batch; None when the
+        dataset is absent."""
+        if dataset_fn == self.train_dataset:
+            declared = self.batch_spec()
+            if declared is not None:
+                from .compile.aot import abstract_spec
+
+                return abstract_spec(declared)
+        try:
+            ds = dataset_fn()
+        except DatasetNotFoundError:
+            return None
+        if iter(ds) is ds:
+            raise ValueError(
+                f"precompile() needs the first batch's shapes, but stage {self.name!r} "
+                "feeds from a one-shot iterator that peeking would consume — declare "
+                "batch_spec() or register a re-iterable dataset"
+            )
+        from .data.device import peek_spec
+
+        spec, _ = peek_spec(ds)
+        return spec
+
+    def _run_precompile_phase(self):
+        """The timed precompile phase: lower+compile every expected train/val
+        signature against abstract specs BEFORE the data loop, so compile
+        cost is measured (``misc/compile_ms``), cache hits are counted, and
+        sharding/shape mismatches fail here — at stage start."""
+        from .compile import aot
+        from .compile import cache as compile_cache
+        from .compile.buckets import bucket_spec
+
+        t0 = time.perf_counter()
+        stats0 = compile_cache.cache_stats()
+        state_spec = aot.abstract_spec(self.state)
+
+        def global_specs(host_spec):
+            if host_spec is None:
+                return []
+            if self._buckets_resolved:
+                host_variants = [
+                    bucket_spec(host_spec, b, mask_key=self.bucket_mask_key())
+                    for b in self._buckets_resolved
+                ]
+            else:
+                host_variants = [host_spec]
+            out = []
+            for hs in host_variants:
+                gs = aot.global_batch_spec(hs, self.mesh)
+                aot.validate_global_batch_spec(gs, self.mesh)
+                out.append(gs)
+            return out
+
+        n_train = 0
+        for gs in global_specs(self._host_batch_spec(self.train_dataset)):
+            self._train_compiled.precompile(state_spec, gs)
+            n_train += 1
+        # val is best-effort: a stage may have no val dataset, or one whose
+        # first-batch peek is impossible — the val step then compiles lazily
+        n_val = 0
+        try:
+            for gs in global_specs(self._host_batch_spec(self.val_dataset)):
+                self._val_compiled.precompile(state_spec, gs)
+                n_val += 1
+        except ValueError as e:
+            self.logger.warning(f"val-step precompile skipped: {e}")
+
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        if not ("misc/compile_ms" in self.tracker and self.tracker.has_value("misc/compile_ms")):
+            self.track("misc/compile_ms", round(elapsed_ms, 3), prefixed=False)
+        stats1 = compile_cache.cache_stats()
+        if n_train or n_val:
+            self.logger.info(
+                f"precompile: {n_train} train + {n_val} val signature(s) in {elapsed_ms:.0f} ms "
+                f"(compile cache: {stats1['aot_hits'] - stats0['aot_hits']} hit(s), "
+                f"{stats1['aot_misses'] - stats0['aot_misses']} miss(es))"
+            )
+        else:
+            self.logger.warning(
+                f"precompile() on stage {self.name!r} found no batch spec to compile "
+                "against; the first step pays the compile as usual"
+            )
 
     def _pre_epoch(self):
         self._stall.reset()  # misc/host_stall_ms is a per-epoch total
@@ -749,6 +911,14 @@ class TrainValStage(Stage):
         # everything the host spent blocked this epoch (value fetches, the
         # epoch-end block_until_ready, waits on async checkpoint commits)
         self.track("misc/host_stall_ms", round(self._stall.ms, 3), prefixed=False)
+        if self._train_compiled is not None:
+            # signatures that showed up this epoch WITHOUT a precompiled
+            # executable — each one was a mid-run XLA compile (0 is the goal;
+            # the TraceGuard wrapper has already warned per growth event)
+            self.tracker.bump(
+                "misc/recompiles",
+                self._train_compiled.pop_recompiles() + self._val_compiled.pop_recompiles(),
+            )
         super()._reduce_metrics()
 
     def _post_epoch(self):
@@ -1071,7 +1241,13 @@ class TrainValStage(Stage):
         """The device feeding path: mesh-sharded batches with
         ``prefetch_depth()`` transfers in flight ahead of the step — and
         optionally ``host_prefetch()`` host batches prepared on a background
-        thread (data/device.py) — or per-step synchronous puts when disabled."""
+        thread (data/device.py) — or per-step synchronous puts when disabled.
+        With ``buckets()`` armed, batches are bucket-padded (+ mask) on host
+        BEFORE the transfer, so the device only ever sees bucket shapes."""
+        if self._buckets_resolved:
+            from .compile.buckets import bucket_iterator
+
+            ds = bucket_iterator(ds, self._buckets_resolved, mask_key=self.bucket_mask_key())
         prefetch = int(self.prefetch_depth())
         if prefetch > 0:
             from .data.device import device_iterator

@@ -1,0 +1,109 @@
+"""Version-compat layer over Orbax's preservation-policy API.
+
+The keep-best retention path (stage.py ``checkpoint_best_metric``) composes
+``AnyPreservationPolicy([LatestN(1), BestN(...)])`` — an API that newer Orbax
+ships as ``orbax.checkpoint.checkpoint_managers`` but that older releases
+(e.g. 0.7.x) do not have at all. Import the policy classes from HERE, never
+from orbax directly:
+
+- on new Orbax the names re-export the real classes and
+  ``CheckpointDir.state_manager`` passes ``preservation_policy`` straight
+  through to ``CheckpointManagerOptions``;
+- on old Orbax the names are lightweight dataclass stand-ins with identical
+  fields, and ``CheckpointDir`` evaluates the policy itself after every save
+  (``steps_to_keep`` below) and deletes the rest via ``manager.delete`` —
+  same retention semantics, implemented host-side.
+
+The shim deliberately covers only the combinators this codebase uses
+(``LatestN``, ``BestN``, ``AnyPreservationPolicy`` = keep if ANY member
+keeps); anything fancier should require new Orbax for real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+__all__ = [
+    "HAS_PRESERVATION_POLICIES",
+    "AnyPreservationPolicy",
+    "BestN",
+    "LatestN",
+    "is_shim_policy",
+    "steps_to_keep",
+]
+
+try:
+    from orbax.checkpoint import checkpoint_managers as _ocm
+
+    HAS_PRESERVATION_POLICIES = True
+    AnyPreservationPolicy = _ocm.AnyPreservationPolicy
+    LatestN = _ocm.LatestN
+    BestN = _ocm.BestN
+except ImportError:  # old orbax: dataclass stand-ins, retention applied by us
+    HAS_PRESERVATION_POLICIES = False
+
+    @dataclasses.dataclass
+    class LatestN:  # noqa: F811 — intentional shadowing of the real class
+        """Keep the ``n`` most recent steps."""
+
+        n: int = 1
+
+    @dataclasses.dataclass
+    class BestN:  # noqa: F811
+        """Keep the ``n`` best steps by ``get_metric_fn`` over the metrics
+        dict passed to ``save``. ``reverse=False`` means larger is better
+        (matching Orbax); metricless steps survive only when
+        ``keep_checkpoints_without_metrics``."""
+
+        get_metric_fn: Callable[[dict], float] = None
+        reverse: bool = False
+        n: int | None = None
+        keep_checkpoints_without_metrics: bool = True
+
+    @dataclasses.dataclass
+    class AnyPreservationPolicy:  # noqa: F811
+        """Keep a step if ANY member policy keeps it (union semantics)."""
+
+        policies: Sequence[Any] = ()
+
+
+def is_shim_policy(policy: Any) -> bool:
+    """Whether ``policy`` must be evaluated host-side (old Orbax): the real
+    API is absent and the object is one of the stand-ins above."""
+    if HAS_PRESERVATION_POLICIES or policy is None:
+        return False
+    return isinstance(policy, (LatestN, BestN, AnyPreservationPolicy))
+
+
+def steps_to_keep(policy: Any, steps: Sequence[int], metrics_by_step: dict[int, dict]) -> set[int]:
+    """Evaluate a (shim) preservation policy over committed ``steps``.
+
+    Returns the set of steps to KEEP; the caller deletes the complement.
+    Union over ``AnyPreservationPolicy`` members, mirroring Orbax.
+    """
+    steps = sorted(set(int(s) for s in steps))
+    members = list(policy.policies) if isinstance(policy, AnyPreservationPolicy) else [policy]
+    keep: set[int] = set()
+    for member in members:
+        if isinstance(member, LatestN):
+            keep.update(steps[-int(member.n):] if member.n else [])
+        elif isinstance(member, BestN):
+            ranked = [s for s in steps if s in metrics_by_step]
+            unranked = [s for s in steps if s not in metrics_by_step]
+            if member.keep_checkpoints_without_metrics:
+                keep.update(unranked)
+            # ascending sort; larger-is-better keeps the tail, reverse=True
+            # (smaller is better) keeps the head — same convention as Orbax
+            ranked.sort(key=lambda s: member.get_metric_fn(metrics_by_step[s]))
+            if member.n is None:
+                keep.update(ranked)
+            elif member.n > 0:
+                keep.update(ranked[-member.n:] if not member.reverse else ranked[: member.n])
+        else:
+            raise TypeError(
+                f"unsupported preservation policy {type(member).__name__!r} on this orbax "
+                "version; upgrade orbax or use LatestN/BestN/AnyPreservationPolicy from "
+                "dmlcloud_tpu.utils.orbax_compat"
+            )
+    return keep

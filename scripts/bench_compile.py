@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Cold-start A/B receipt: persistent-cache time-to-first-step (cold vs
+warm, fresh process each) and ragged-batch compiled-signature growth with
+vs without shape buckets (doc/performance.md §4).
+
+Thin CLI over ``bench.bench_compile`` (which runs ``bench.py
+--compile-child`` CPU-pinned) so the committed receipt and an interactive
+investigation run the exact same workload.
+
+    JAX_PLATFORMS=cpu python scripts/bench_compile.py --out BENCH_compile_pr03.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    parser.add_argument(
+        "--smoke", action="store_true", help="tiny shapes (sets DML_BENCH_SMOKE for the children)"
+    )
+    args = parser.parse_args()
+    if args.smoke:
+        os.environ["DML_BENCH_SMOKE"] = "1"
+
+    from bench import bench_compile
+
+    results = bench_compile()
+    if results is None:
+        print("compile bench failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,3 +32,11 @@ class WhereStage(TrainValStage):
         if chunk > 0:  # fine: config scalar, fixed per trace
             loss = loss / chunk
         return jnp.where(loss > 1.0, loss * 0.5, loss)
+
+
+class MaskedStage(TrainValStage):
+    def step(self, state, batch):
+        per_sample = state.apply_fn(state.params, batch["x"])
+        if "sample_mask" in batch:  # fine: pytree structure is static under trace
+            return (per_sample * batch["sample_mask"]).sum()
+        return per_sample.mean()

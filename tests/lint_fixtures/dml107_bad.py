@@ -1,0 +1,34 @@
+"""DML107 bad fixture: jit built inside loop bodies — each iteration creates
+a fresh jitted callable with an empty cache, so every iteration re-traces
+and re-compiles."""
+
+import functools
+
+import jax
+
+
+def sweep(batches, g):
+    results = []
+    for batch in batches:
+        f = jax.jit(g)  # BAD: fresh jit (and fresh compile) per iteration
+        results.append(f(batch))
+    return results
+
+
+def poll(g, batch):
+    out = None
+    while out is None:
+        f = functools.partial(jax.jit, donate_argnums=0)(g)  # BAD
+        out = f(batch)
+    return out
+
+
+def decorated_in_loop(batches):
+    outs = []
+    for batch in batches:
+        @jax.jit  # BAD: the def re-executes (re-jits) every iteration
+        def kernel(x):
+            return x * 2
+
+        outs.append(kernel(batch))
+    return outs

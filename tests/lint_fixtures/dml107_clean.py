@@ -1,0 +1,31 @@
+"""DML107 clean fixture: jit hoisted out of loops; AOT warming and nested
+factory defs inside loops are fine (their bodies run at call time)."""
+
+import jax
+
+double = jax.jit(lambda x: x * 2)  # module scope: jitted once
+
+
+def run(batches):
+    f = jax.jit(lambda x: x + 1)  # once, before the loop
+    out = []
+    for batch in batches:
+        out.append(f(batch))
+    return out
+
+
+def aot_warm(fn, specs):
+    compiled = []
+    for spec in specs:
+        compiled.append(fn.lower(spec).compile())  # AOT pattern: no new jit
+    return compiled
+
+
+def factory_in_loop(fns):
+    makers = []
+    for g in fns:
+        def make(g=g):
+            return jax.jit(g)  # executes when called, not per loop iteration
+
+        makers.append(make)
+    return makers

@@ -292,6 +292,61 @@ class TestDataPipeline:
             np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
             assert isinstance(b["x"], jax.Array)
 
+    def test_device_iterator_prefetch_zero_is_strictly_synchronous(self, single_runtime):
+        """Depth 0 must transfer NOTHING ahead of consumption: after pulling
+        one batch, exactly one batch has been read from the source (the old
+        behavior eagerly transferred one batch ahead)."""
+        from dmlcloud_tpu.data.device import device_iterator
+        from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.create_mesh({"data": 8})
+        pulled = []
+
+        def source():
+            for i in range(4):
+                pulled.append(i)
+                yield {"x": np.full((16, 1), i, np.float32)}
+
+        it = device_iterator(source(), mesh, prefetch=0)
+        assert pulled == []  # nothing moves before the first next()
+        first = next(it)
+        assert pulled == [0]
+        np.testing.assert_array_equal(np.asarray(first["x"]), np.full((16, 1), 0.0))
+        next(it)
+        assert pulled == [0, 1]
+        assert len(list(it)) == 2  # the remainder still arrives, in order
+        # contrast: depth 2 keeps transfers in flight ahead of consumption
+        pulled.clear()
+        it2 = device_iterator(source(), mesh, prefetch=2)
+        next(it2)
+        assert pulled == [0, 1]  # one batch ahead already in flight
+
+    def test_peek_spec_reiterable_untouched(self, single_runtime):
+        from dmlcloud_tpu.data.device import peek_spec
+
+        batches = [{"x": np.zeros((4, 2), np.float32)} for _ in range(3)]
+        spec, out = peek_spec(batches)
+        assert out is batches  # re-iterable sources come back untouched
+        assert spec["x"].shape == (4, 2) and spec["x"].dtype == np.float32
+        assert len(list(out)) == 3
+
+    def test_peek_spec_one_shot_iterator_replays_first_batch(self, single_runtime):
+        from dmlcloud_tpu.data.device import peek_spec
+
+        src = ({"x": np.full((2,), i, np.float32)} for i in range(3))
+        spec, out = peek_spec(src)
+        assert spec["x"].shape == (2,)
+        vals = [int(b["x"][0]) for b in out]
+        assert vals == [0, 1, 2]  # the peeked batch is not lost
+
+    def test_peek_spec_empty_dataset_raises(self, single_runtime):
+        import pytest
+
+        from dmlcloud_tpu.data.device import peek_spec
+
+        with pytest.raises(ValueError, match="empty"):
+            peek_spec([])
+
     def test_shims_pickle_roundtrip(self, single_runtime):
         """DataLoader workers receive datasets by pickle; the shims must
         survive the round trip with epoch intact."""

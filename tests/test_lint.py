@@ -34,6 +34,7 @@ BAD_EXPECT = {
     "DML104": 4,
     "DML105": 2,
     "DML106": 2,
+    "DML107": 3,
 }
 
 
