@@ -8,7 +8,7 @@ step instead of hook-driven allreduce, the jax.distributed coordination
 service instead of c10d rendezvous, and Orbax for sharded tensor state.
 """
 
-from . import compile, data, lint, metrics, parallel, utils
+from . import compile, data, lint, metrics, parallel, telemetry, utils
 from .checkpoint import CheckpointDir, find_slurm_checkpoint, generate_checkpoint_path
 from .metrics import MetricReducer, MetricTracker, Reduction
 from .pipeline import TrainingPipeline
@@ -23,6 +23,7 @@ __all__ = [
     "lint",
     "metrics",
     "parallel",
+    "telemetry",
     "utils",
     "CheckpointDir",
     "find_slurm_checkpoint",

@@ -7,11 +7,17 @@
   its equivalent is buried in run logs (util/logging.py:131-173).
 - ``lint``: the AST-based TPU-hazard linter (doc/lint.md) — enforces the
   overlap engine's sync-point contract on CPU, no jax import needed.
+- ``timeline``: merge a telemetry-armed run's per-host span journals
+  (doc/observability.md) into one Perfetto/Chrome-trace JSON — open it in
+  https://ui.perfetto.dev or chrome://tracing and every rank's epochs,
+  step dispatches, data waits, checkpoints, and barriers share one ruler.
+  Pure stdlib: runs anywhere the run dir is mounted.
 
     python -m dmlcloud_tpu                  # diagnostics (diag is implied)
     python -m dmlcloud_tpu --json           # machine-readable diagnostics
-    python -m dmlcloud_tpu diag [--json]
+    python -m dmlcloud_tpu diag [--json] [--run RUN_DIR]
     python -m dmlcloud_tpu lint [paths...] [--json] [--list-rules]
+    python -m dmlcloud_tpu timeline RUN_DIR [-o trace.json]
 
 The bare invocation (no subcommand) stays diag for backward compatibility
 with existing wrappers and docs.
@@ -21,7 +27,87 @@ import argparse
 import json
 import sys
 
-_SUBCOMMANDS = ("diag", "lint")
+_SUBCOMMANDS = ("diag", "lint", "timeline")
+
+
+def _timeline_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlcloud_tpu timeline",
+        description="Merge a run's per-host telemetry journals into Chrome-trace JSON.",
+    )
+    parser.add_argument(
+        "run_dir",
+        help="run directory of a TrainingPipeline(telemetry=...) run "
+        "(or its telemetry/ subdirectory)",
+    )
+    parser.add_argument(
+        "-o", "--output", default=None,
+        help="write the trace JSON here (default: stdout)",
+    )
+    args = parser.parse_args(argv)
+
+    # stdlib-only on purpose: no jax import, so journals can be converted on
+    # a laptop that has only the run directory
+    from .telemetry.journal import load_journals, to_chrome_trace
+
+    try:
+        records = load_journals(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"timeline: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print(f"timeline: journals under {args.run_dir} contain no spans", file=sys.stderr)
+        return 1
+    trace = to_chrome_trace(records)
+    ranks = sorted({r.get("rank", 0) for r in records})
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        print(
+            f"wrote {len(trace['traceEvents'])} events from {len(records)} spans "
+            f"({len(ranks)} rank(s)) to {args.output} — open in https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    else:
+        json.dump(trace, sys.stdout)
+        print()
+    return 0
+
+
+def _run_telemetry_summary(run_dir: str) -> dict:
+    """The diag view of one run's telemetry artifacts: goodput ledger totals
+    + journal span counts (or an ``error`` explaining what's missing)."""
+    import os
+
+    from .telemetry.journal import load_journals
+
+    out: dict = {"run_dir": run_dir}
+    gp_path = None
+    for cand in (os.path.join(run_dir, "telemetry", "goodput.json"), os.path.join(run_dir, "goodput.json")):
+        if os.path.isfile(cand):
+            gp_path = cand
+            break
+    if gp_path is not None:
+        try:
+            with open(gp_path, "r", encoding="utf-8") as f:
+                out["goodput"] = json.load(f)["totals"]
+        except (OSError, ValueError, KeyError) as e:
+            out["goodput_error"] = f"unreadable {gp_path}: {e}"
+    else:
+        out["goodput_error"] = "no goodput.json (run still in flight, or telemetry not armed?)"
+    try:
+        records = load_journals(run_dir)
+        counts: dict[str, int] = {}
+        for r in records:
+            counts[r.get("kind", "?")] = counts.get(r.get("kind", "?"), 0) + 1
+        out["journal"] = {
+            "spans": len(records),
+            "ranks": len({r.get("rank", 0) for r in records}),
+            "kinds": {k: counts[k] for k in sorted(counts)},
+        }
+    except FileNotFoundError as e:
+        out["journal_error"] = str(e)
+    return out
 
 
 def _diag_main(argv) -> int:
@@ -30,6 +116,11 @@ def _diag_main(argv) -> int:
         description="Print environment/topology diagnostics.",
     )
     parser.add_argument("--json", action="store_true", help="machine-readable subset")
+    parser.add_argument(
+        "--run", default=None, metavar="RUN_DIR",
+        help="also summarize a telemetry-armed run directory (goodput ledger "
+        "totals + journal span counts)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -39,6 +130,7 @@ def _diag_main(argv) -> int:
     from .utils.logging import accelerator_info, general_diagnostics
 
     cache = cache_stats()
+    telemetry = _run_telemetry_summary(args.run) if args.run else None
     if not args.json:
         print(f"dmlcloud_tpu {__version__}")
         print(general_diagnostics())
@@ -48,10 +140,29 @@ def _diag_main(argv) -> int:
             else "disabled (TrainingPipeline(compile_cache=True) or $DMLCLOUD_COMPILE_CACHE_DIR)"
         )
         print(f"* COMPILE CACHE:\n    - dir: {cache['dir']}\n    - state: {state}")
+        if telemetry is not None:
+            print(f"* TELEMETRY ({telemetry['run_dir']}):")
+            gp = telemetry.get("goodput")
+            if gp is not None:
+                print(
+                    f"    - goodput: {gp.get('goodput_frac')} over {gp.get('epochs')} epoch(s) "
+                    f"({gp.get('wall_s')}s wall: {gp.get('compile_s')} compile, "
+                    f"{gp.get('data_wait_s')} data_wait, {gp.get('ckpt_s')} ckpt, "
+                    f"{gp.get('host_stall_s')} host_stall, {gp.get('productive_s')} productive)"
+                )
+            else:
+                print(f"    - goodput: {telemetry.get('goodput_error')}")
+            j = telemetry.get("journal")
+            if j is not None:
+                print(f"    - journal: {j['spans']} spans across {j['ranks']} rank(s): {j['kinds']}")
+            else:
+                print(f"    - journal: {telemetry.get('journal_error')}")
         return 0
 
     info = {"version": __version__, "python": sys.version.split()[0], "jax": jax.__version__}
     info["compile_cache"] = cache
+    if telemetry is not None:
+        info["telemetry"] = telemetry
     info.update(accelerator_info())  # {"error": ...} when backend init fails
     print(json.dumps(info))
     return 1 if "error" in info else 0
@@ -63,6 +174,8 @@ def main(argv=None) -> int:
         from .lint.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "timeline":
+        return _timeline_main(argv[1:])
     if argv and argv[0] == "diag":
         argv = argv[1:]
     elif argv and not argv[0].startswith("-"):

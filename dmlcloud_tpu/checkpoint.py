@@ -285,7 +285,10 @@ class CheckpointDir:
         """Save a pytree of (possibly sharded) arrays under ``state/<step>``."""
         import orbax.checkpoint as ocp
 
-        self.state_manager(scope).save(step, args=ocp.args.StandardSave(state), **kwargs)
+        from .telemetry import journal as _journal
+
+        with _journal.span("checkpoint", label=scope, op="save", step=int(step)):
+            self.state_manager(scope).save(step, args=ocp.args.StandardSave(state), **kwargs)
         if scope in self._retention_policies:
             self._apply_retention(scope, step, kwargs.get("metrics"))
 
@@ -351,13 +354,17 @@ class CheckpointDir:
         every manager (the default). The overlap engine's sync points
         (pre-save single-flight wait, stage end, run end, preemption exit)
         all land here; a scope with no manager yet is a no-op."""
+        from .telemetry import journal as _journal
+
         if scope is not CheckpointDir._ALL_SCOPES:
             mgr = self._state_managers.get(scope)
             if mgr is not None:
-                mgr.wait_until_finished()
+                with _journal.span("checkpoint", label=scope, op="wait"):
+                    mgr.wait_until_finished()
             return
-        for mgr in self._state_managers.values():
-            mgr.wait_until_finished()
+        with _journal.span("checkpoint", op="wait_all"):
+            for mgr in self._state_managers.values():
+                mgr.wait_until_finished()
 
     def close(self) -> None:
         for mgr in self._state_managers.values():

@@ -139,9 +139,12 @@ class PrecompiledStep:
         sig = signature_of(abstract_args)
         if sig in self._compiled:
             return 0.0
+        from ..telemetry import journal as _journal
+
         entries_before = cache_lib.entry_count()
         t0 = time.perf_counter()
-        compiled = self._fn.lower(*abstract_args).compile()
+        with _journal.span("compile", label=self.name, signature=len(self._compiled) + 1):
+            compiled = self._fn.lower(*abstract_args).compile()
         elapsed_ms = (time.perf_counter() - t0) * 1e3
         entries_after = cache_lib.entry_count()
         hit = (
@@ -166,6 +169,12 @@ class PrecompiledStep:
         return self._fn(*args)  # jit path: compiles (or cache-hits) on its own
 
     # -- introspection ------------------------------------------------------
+    def any_compiled(self) -> Any:
+        """One AOT-compiled executable (arbitrary signature), or None —
+        enough for per-step cost analysis (telemetry/goodput.py), which is
+        signature-independent to first order."""
+        return next(iter(self._compiled.values()), None)
+
     @property
     def signatures(self) -> int:
         """Distinct signatures precompiled (the bounded set buckets target)."""

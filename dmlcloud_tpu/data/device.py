@@ -27,6 +27,7 @@ from typing import Any, Iterable, Iterator
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..telemetry import journal as _journal
 
 
 def peek_spec(it: Iterable[Any]) -> tuple[Any, Iterable[Any]]:
@@ -78,7 +79,9 @@ def device_iterator(
         # strictly synchronous: one transfer per consumed batch, nothing
         # pulled from the source (or put on device) ahead of the step
         for batch in src:
-            yield mesh_lib.make_global_batch(batch, mesh, pspec)
+            with _journal.span("h2d", prefetch=0):
+                yield_batch = mesh_lib.make_global_batch(batch, mesh, pspec)
+            yield yield_batch
         return
 
     def enqueue(n: int) -> None:
@@ -87,7 +90,10 @@ def device_iterator(
                 batch = next(src)
             except StopIteration:
                 return
-            queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
+            # the span covers the host-side put dispatch only — the copy
+            # itself is async and overlaps compute (that's the point)
+            with _journal.span("h2d", prefetch=prefetch):
+                queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
 
     enqueue(prefetch)
     while queue:

@@ -1,4 +1,4 @@
-"""The TPU-hazard rules (DML101-DML107).
+"""The TPU-hazard rules (DML101-DML108).
 
 Each rule enforces one clause of the overlap engine's sync-point contract
 (doc/performance.md §3, doc/lint.md for the full catalog with examples):
@@ -10,6 +10,7 @@ Each rule enforces one clause of the overlap engine's sync-point contract
 - DML105  blocking checkpoint/wandb calls inside the epoch loop
 - DML106  wall-clock timing of async dispatches without a device sync
 - DML107  jax.jit / pjit call inside a loop body (defeats the jit cache)
+- DML108  time.time() for step timing in step/epoch code (not monotonic)
 
 Rules yield raw findings; the engine applies suppressions and sorting.
 """
@@ -386,6 +387,34 @@ def check_dishonest_timing(ctx: ModuleCtx):
 
 
 # ------------------------------------------------------------------- DML107
+
+
+@rule("DML108", "time.time() used for step timing in step/epoch code")
+def check_wall_clock_step_timing(ctx: ModuleCtx):
+    """``time.time()`` reads the WALL clock, which NTP slews and steps —
+    a few-ms jump is routine, a leap-second or chrony correction can move
+    it by seconds in either direction, and every span/step duration derived
+    from it is then silently wrong (negative durations crash trace viewers;
+    inflated ones send you hunting a stall that never happened). Step and
+    epoch code must time with ``time.perf_counter()`` /
+    ``time.perf_counter_ns()`` — monotonic, and what the telemetry journal's
+    own span durations use (wall clock appears only as the journal's one
+    mergeable anchor per run). Outside the hazard contexts (logging a
+    human-readable start time, naming a checkpoint dir) ``time.time()`` is
+    fine and not flagged."""
+    for fn in ctx.step_fns + ctx.epoch_fns:
+        for node, _ in walk_fn(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func) or ""
+            if resolved in ("time.time", "time.time_ns"):
+                yield _f(
+                    ctx, "DML108", node,
+                    f"{resolved}() is wall-clock (NTP can step it mid-run, corrupting "
+                    "step/span durations); time step and epoch code with the monotonic "
+                    "time.perf_counter()/perf_counter_ns()",
+                    fn.qualname,
+                )
 
 
 @rule("DML107", "jax.jit/pjit call inside a loop body")

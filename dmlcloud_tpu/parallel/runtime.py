@@ -30,6 +30,7 @@ import functools
 import logging
 import os
 import pickle
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -370,6 +371,19 @@ _seq = {"barrier": 0, "obj": 0}
 #: barrier — see the retention note inside ``barrier()``.
 _gc_barrier_ids: list = []
 
+#: Snapshot of this rank's most recent barrier: tag, status
+#: ("waiting"/"completed"/"timeout"), entry wall-clock, and — after a
+#: timeout — the straggler ranks that never arrived. The telemetry flight
+#: recorder (telemetry/watchdog.py) embeds this in its forensics dump so a
+#: hang post-mortem names the rank everyone else was waiting on.
+_barrier_state: dict = {}
+
+
+def barrier_state() -> dict:
+    """Copy of this rank's most recent barrier record (see ``_barrier_state``);
+    empty before the first barrier."""
+    return dict(_barrier_state)
+
 
 class BarrierTimeout(RuntimeError):
     """A barrier timed out; ``stragglers`` lists the ranks that never arrived
@@ -414,9 +428,23 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
     """
     if world_size() <= 1:
         return
+    from ..telemetry import journal as _journal  # stdlib-only; no import cycle
+
     client = _client()
     _seq["barrier"] += 1
     barrier_id = f"dmlcloud_tpu:{tag}:{_seq['barrier']}"
+    _barrier_state.clear()
+    _barrier_state.update(
+        {
+            "tag": tag,
+            "id": barrier_id,
+            "rank": rank(),
+            "status": "waiting",
+            "entered_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "timeout_s": timeout,
+        }
+    )
+    _t0 = _journal.now()
     if client is not None:
         # Arrival-key retention: keys are NOT deleted when their own barrier
         # completes — a rank whose timer expired in the same instant the
@@ -432,8 +460,16 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
         except Exception as e:
             msg = str(e).lower()
             if "deadline" in msg or "timeout" in msg or "timed out" in msg:
-                raise BarrierTimeout(tag, timeout, _find_stragglers(client, barrier_id)) from e
+                stragglers = _find_stragglers(client, barrier_id)
+                # feed the flight recorder BEFORE raising: the forensics dump
+                # this timeout usually precipitates must name the late ranks
+                _barrier_state.update({"status": "timeout", "stragglers": stragglers})
+                _journal.emit("barrier", _t0, label=tag, status="timeout", stragglers=stragglers)
+                raise BarrierTimeout(tag, timeout, stragglers) from e
+            _barrier_state["status"] = "error"
             raise  # not a timeout (e.g. coordinator connection lost) — do not misdiagnose
+        _barrier_state["status"] = "completed"
+        _journal.emit("barrier", _t0, label=tag, status="completed")
         if is_root():
             for done_id in _gc_barrier_ids:
                 for src in range(world_size()):
@@ -447,6 +483,8 @@ def barrier(tag: str = "", timeout: float = _DEFAULT_TIMEOUT) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(barrier_id)
+        _barrier_state["status"] = "completed"
+        _journal.emit("barrier", _t0, label=tag, status="completed")
 
 
 def _kv_key(name: str, seq: int, src: int) -> str:

@@ -59,6 +59,7 @@ class TrainingPipeline:
         compile_cache: Any = None,
         precompile: bool = False,
         buckets: Any = None,
+        telemetry: Any = None,
     ):
         """``lint`` arms the TPU-hazard linter (dmlcloud_tpu.lint) over every
         registered Stage subclass's source at run start: ``"warn"`` logs the
@@ -80,7 +81,19 @@ class TrainingPipeline:
           abstract spec, before the data loop.
         - ``buckets``: default for ``Stage.buckets()`` — pad ragged batch
           dims to this ascending size set (with a zero-weight sample mask)
-          so the compiled-signature count stays bounded."""
+          so the compiled-signature count stays bounded.
+
+        ``telemetry`` arms the flight recorder (dmlcloud_tpu.telemetry;
+        doc/observability.md): a per-host span journal (JSONL, merged by
+        ``python -m dmlcloud_tpu timeline <run_dir>``), the goodput/MFU
+        ledger (``misc/goodput``/``misc/mfu`` + a root-only end-of-run
+        table), and the hang watchdog (forensics dump when step/span
+        progress stops). ``True`` journals into ``<checkpoint_dir>/
+        telemetry`` (or ``./telemetry`` without checkpointing / on remote
+        checkpoint paths); a path selects the directory; a dict configures
+        ``{"dir", "hang_threshold_s" (default 600), "watchdog_interval_s"
+        (10), "ring_size" (1024)}``. None/False (default): fully off — the
+        instrumentation points reduce to one attribute read."""
         if lint not in (None, "warn", "error"):
             raise ValueError(f'lint must be None, "warn" or "error", got {lint!r}')
         self.config: Config = as_config(config)
@@ -90,6 +103,15 @@ class TrainingPipeline:
         self._compile_cache_dir: str | None = None
         self._precompile = bool(precompile)
         self._buckets = tuple(buckets) if buckets else None
+        if telemetry is not None and not isinstance(telemetry, (bool, str, dict)) and not hasattr(telemetry, "__fspath__"):
+            raise ValueError(
+                f"telemetry must be None/bool, a directory path, or a config dict, got {telemetry!r}"
+            )
+        self._telemetry_cfg = telemetry
+        self.telemetry_dir: str | None = None
+        self._journal = None
+        self._watchdog = None
+        self._run_span_t0: float | None = None
 
         self.logger = logging.getLogger("dmlcloud_tpu")
         self.checkpoint_dir: CheckpointDir | None = None
@@ -123,6 +145,11 @@ class TrainingPipeline:
     @property
     def checkpointing_enabled(self) -> bool:
         return self.checkpoint_dir is not None
+
+    @property
+    def telemetry_armed(self) -> bool:
+        """True between telemetry arming at run start and teardown."""
+        return self._journal is not None
 
     def set_mesh(self, mesh_or_axes) -> None:
         """Set the device mesh (a ``jax.sharding.Mesh`` or an axes dict like
@@ -521,6 +548,7 @@ class TrainingPipeline:
         self.barrier(timeout=600)
         if self.checkpointing_enabled:
             self._init_checkpointing()
+        self._arm_telemetry()
 
         if self.wandb:
             self._start_wandb()
@@ -561,6 +589,101 @@ class TrainingPipeline:
 
         self.pre_run()
 
+    def _arm_telemetry(self):
+        """Start the flight recorder: journal + goodput + hang watchdog
+        (dmlcloud_tpu.telemetry). Per-host — every rank journals and
+        watches; only the root prints the end-of-run ledger."""
+        cfg = self._telemetry_cfg
+        if cfg is None or cfg is False:
+            return
+        import os
+
+        from .checkpoint import is_remote_path
+        from .telemetry import journal as journal_mod
+        from .telemetry.watchdog import HangWatchdog
+
+        opts = dict(cfg) if isinstance(cfg, dict) else {}
+        tdir = opts.get("dir")
+        if tdir is None and not isinstance(cfg, (bool, dict)):
+            tdir = os.fspath(cfg)
+        if tdir is None:
+            # journals are plain local appends; a gs://... checkpoint root
+            # cannot take them, so fall back to the working directory
+            if self.checkpoint_dir is not None and not is_remote_path(self.checkpoint_dir.path):
+                tdir = str(self.checkpoint_dir.path / "telemetry")
+            else:
+                tdir = os.path.abspath("telemetry")
+        self.telemetry_dir = str(tdir)
+        self._journal = journal_mod.SpanJournal(
+            self.telemetry_dir,
+            rank=runtime.rank(),
+            ring_size=int(opts.get("ring_size", 1024)),
+        )
+        journal_mod.activate(self._journal)
+        self._journal.start()
+        forensics_dir = os.path.join(self.telemetry_dir, os.pardir, "forensics")
+        if self.checkpoint_dir is not None and not is_remote_path(self.checkpoint_dir.path):
+            forensics_dir = str(self.checkpoint_dir.path / "forensics")
+        self._watchdog = HangWatchdog(
+            os.path.normpath(forensics_dir),
+            rank=runtime.rank(),
+            world_size=runtime.world_size(),
+            threshold_s=float(opts.get("hang_threshold_s", 600.0)),
+            interval_s=float(opts.get("watchdog_interval_s", 10.0)),
+            journal=self._journal,
+        )
+        self._journal.on_emit = self._watchdog.notify
+        self._watchdog.start()
+        self._run_span_t0 = journal_mod.now()
+        if runtime.is_root():
+            self.logger.info(
+                "telemetry armed: journal %s, forensics %s (hang threshold %.0fs)",
+                self.telemetry_dir, self._watchdog.dump_dir, self._watchdog.threshold_s,
+            )
+
+    def _telemetry_ledger(self):
+        """Root-only end-of-run goodput ledger: log the table and persist
+        ``goodput.json`` next to the journals."""
+        from .telemetry import journal as journal_mod
+        from .telemetry.goodput import ledger_from_tracker
+
+        if self._run_span_t0 is not None:
+            journal_mod.emit("run", self._run_span_t0, label=self.name or "run")
+        ledger = ledger_from_tracker(self.tracker)
+        if not runtime.is_root():
+            return
+        if ledger.rows:
+            self.logger.info("\n%s", ledger.format_table())
+        import json
+        import os
+
+        try:
+            with open(os.path.join(self.telemetry_dir, "goodput.json"), "w", encoding="utf-8") as f:
+                json.dump(ledger.to_dict(), f)
+        except OSError:
+            self.logger.warning("could not write %s/goodput.json", self.telemetry_dir, exc_info=True)
+
+    def _disarm_telemetry(self, exc: BaseException | None = None):
+        """Teardown half of ``_arm_telemetry`` — always runs (run guard).
+        An uncaught exception triggers a forensics dump first: the flight
+        recorder's whole point is that the crash leaves evidence behind."""
+        from .telemetry import journal as journal_mod
+
+        if self._watchdog is not None:
+            if exc is not None and not isinstance(exc, KeyboardInterrupt):
+                try:
+                    path = self._watchdog.dump(f"uncaught exception: {type(exc).__name__}: {exc}")
+                    self.logger.info("forensics dumped to %s", path)
+                except Exception:
+                    self.logger.warning("forensics dump failed", exc_info=True)
+            self._watchdog.stop()
+            self._watchdog = None
+        if self._journal is not None:
+            if journal_mod.active_journal() is self._journal:
+                journal_mod.deactivate()
+            self._journal.close()
+            self._journal = None
+
     @runtime.root_only
     def _init_checkpointing(self):
         if not self.checkpoint_dir.is_valid:
@@ -577,6 +700,8 @@ class TrainingPipeline:
         self.stop_time = datetime.now()
         if self.checkpoint_dir is not None:
             self.checkpoint_dir.wait_until_finished()
+        if self.telemetry_armed:
+            self._telemetry_ledger()
         # shared-FS aware: every process shares the cache dir, process 0 logs
         if self._compile_cache_dir is not None and runtime.is_root():
             from .compile.cache import cache_stats
@@ -614,6 +739,10 @@ class TrainingPipeline:
             self.logger.info("=== run aborted by user (KeyboardInterrupt) ===")
         elif exc is not None:
             self.logger.error("=== run failed; traceback follows ===", exc_info=exc)
+        try:
+            self._disarm_telemetry(exc)
+        except Exception:
+            self.logger.warning("telemetry teardown failed", exc_info=True)
         if self.checkpoint_dir is not None:
             # a failed/interrupted run may still have an async save in
             # flight: let it commit (or surface its own error to the log)

@@ -56,6 +56,7 @@ from .metrics import MetricTracker, Reduction
 from .parallel import mesh as mesh_lib
 from .parallel import runtime
 from .parallel.runtime import is_root
+from .telemetry import journal as _journal
 from .train_state import TrainState
 from .utils.logging import DevNullIO, flush_log_handlers
 from .utils.profiling import StallTimer
@@ -93,6 +94,8 @@ class Stage:
         self.metric_prefix = None
         self.table = None
         self.barrier_timeout = None
+        self._stage_span_t0 = 0.0
+        self._epoch_span_t0 = 0.0
 
     # -- conveniences -------------------------------------------------------
     @property
@@ -201,6 +204,7 @@ class Stage:
 
     def _pre_stage(self):
         self.start_time = datetime.now()
+        self._stage_span_t0 = _journal.now()
         # NOTE: root-only table — fixes the reference quirk of passing the
         # function `is_root` (always truthy) instead of calling it (stage.py:147).
         self.table = ProgressTable(file=sys.stdout if is_root() else DevNullIO())
@@ -216,17 +220,20 @@ class Stage:
         self.post_stage()
         self.pipeline.barrier(self.barrier_timeout)
         self.stop_time = datetime.now()
+        _journal.emit("stage", self._stage_span_t0, label=self.name, epochs=self.current_epoch - 1)
         if len(self.pipeline.stages) > 1:
             self.logger.info(f"Finished stage in {self.stop_time - self.start_time}")
 
     def _pre_epoch(self):
         self.epoch_start_time = datetime.now()
+        self._epoch_span_t0 = _journal.now()
         self.table["Epoch"] = self.current_epoch
         self.pre_epoch()
         self.pipeline._pre_epoch()
 
     def _post_epoch(self):
         self.epoch_stop_time = datetime.now()
+        _journal.emit("epoch", self._epoch_span_t0, label=self.name, epoch=self.current_epoch)
         self._reduce_metrics()
         self.post_epoch()
         self.pipeline._post_epoch()
@@ -324,6 +331,12 @@ class TrainValStage(Stage):
         #: window in which NO device readback may happen under
         #: ``deferred_metrics()`` (tests assert against it)
         self._in_step_loop = False
+        #: telemetry (flight recorder) accounting: host ns spent blocked in
+        #: the feed iterator's next() this epoch (the goodput ledger's
+        #: data_wait bucket), and the cached cost-analysis FLOPs fallback
+        #: for MFU when step_flops() is not declared
+        self._gp_data_wait_ns = 0
+        self._cost_flops: float | None = None
 
     # -- overridables (parity: reference stage.py:228-257) ------------------
     def train_dataset(self):
@@ -905,12 +918,40 @@ class TrainValStage(Stage):
 
     def _pre_epoch(self):
         self._stall.reset()  # misc/host_stall_ms is a per-epoch total
+        self._gp_data_wait_ns = 0
         super()._pre_epoch()
+
+    @property
+    def _telemetry_armed(self) -> bool:
+        return bool(getattr(self.pipeline, "telemetry_armed", False))
 
     def _reduce_metrics(self):
         # everything the host spent blocked this epoch (value fetches, the
         # epoch-end block_until_ready, waits on async checkpoint commits)
         self.track("misc/host_stall_ms", round(self._stall.ms, 3), prefixed=False)
+        if self._telemetry_armed and self.epoch_stop_time is not None:
+            # the goodput ledger's per-epoch buckets (telemetry/goodput.py):
+            # disjoint by construction — data_wait is timed OUTSIDE the stall
+            # timer, ckpt is the stall timer's 'checkpoint' share, and
+            # productive is the remainder. MEAN-reduced across hosts on the
+            # packed epoch-end collective like any other scalar metric.
+            epoch_s = (self.epoch_stop_time - self.epoch_start_time).total_seconds()
+            data_wait_ms = self._gp_data_wait_ns / 1e6
+            ckpt_ms = self._stall.label_ms("checkpoint")
+            stall_ms = self._stall.ms  # includes the checkpoint share
+            productive_s = max(epoch_s - (data_wait_ms + stall_ms) / 1e3, 0.0)
+            self.track_reduce(
+                "misc/data_wait_ms", round(data_wait_ms, 3), reduction=Reduction.MEAN, prefixed=False
+            )
+            self.track_reduce(
+                "misc/ckpt_ms", round(ckpt_ms, 3), reduction=Reduction.MEAN, prefixed=False
+            )
+            self.track_reduce(
+                "misc/goodput",
+                round(productive_s / epoch_s, 6) if epoch_s > 0 else 0.0,
+                reduction=Reduction.MEAN,
+                prefixed=False,
+            )
         if self._train_compiled is not None:
             # signatures that showed up this epoch WITHOUT a precompiled
             # executable — each one was a mid-run XLA compile (0 is the goal;
@@ -984,7 +1025,7 @@ class TrainValStage(Stage):
         # is waited out (timed as stall) before the new one dispatches. The
         # save call itself is timed too — async it costs one D2H snapshot,
         # sync (async_checkpoint() False) it blocks for the full commit.
-        with self._stall.measure():
+        with self._stall.measure(label="checkpoint"):
             ckpt.wait_until_finished(scope=self.name)
             ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
         if is_root():
@@ -1037,7 +1078,7 @@ class TrainValStage(Stage):
         a root-written sidecar recording where inside which epoch it landed
         (what a resume needs to fast-forward the data)."""
         ckpt = self.pipeline.checkpoint_dir
-        with self._stall.measure():
+        with self._stall.measure(label="checkpoint"):
             # at most one save in flight; the step-counter fetch blocks on
             # the dispatched steps, so both waits count as host stall — as
             # does the save call itself (one D2H snapshot when async, the
@@ -1226,6 +1267,23 @@ class TrainValStage(Stage):
                 f"Restored stage '{self.name}' state from epoch {latest}; continuing at epoch {self.current_epoch}"
             )
 
+    def _cost_analysis_flops(self) -> float:
+        """MFU fallback when ``step_flops()`` is not declared: whole-mesh
+        FLOPs of one step from the AOT-compiled executable's own XLA cost
+        analysis (0.0 when no compiled executable or no counter — the MFU
+        metric is then skipped, never invented). Cached: the analysis is
+        signature-independent to first order."""
+        if self._cost_flops is None:
+            val = 0.0
+            if self._train_compiled is not None:
+                exe = self._train_compiled.any_compiled()
+                if exe is not None:
+                    from .telemetry.goodput import flops_from_compiled
+
+                    val = flops_from_compiled(exe, n_devices=int(self.mesh.devices.size)) or 0.0
+            self._cost_flops = val
+        return self._cost_flops
+
     def run_epoch(self):
         self.train_epoch()
         if self._mid_epoch_exit:
@@ -1256,6 +1314,25 @@ class TrainValStage(Stage):
                 ds, self.mesh, prefetch=prefetch, host_prefetch=int(self.host_prefetch())
             )
         return (self._put(batch) for batch in ds)
+
+    def _timed_feed(self, ds):
+        """``_feed`` with each ``next()`` timed as the goodput ledger's
+        data_wait bucket (+ a journal span per batch). Only interposed when
+        telemetry is armed — the default feeding path is untouched."""
+        it = iter(self._feed(ds))
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            t1 = time.perf_counter()
+            self._gp_data_wait_ns += int((t1 - t0) * 1e9)
+            _journal.emit("data_wait", t0, t1)
+            yield batch
+
+    def _feed_for_epoch(self, ds):
+        return self._timed_feed(ds) if self._telemetry_armed else self._feed(ds)
 
     def train_epoch(self):
         self.is_train = True
@@ -1313,13 +1390,16 @@ class TrainValStage(Stage):
         last_metrics = None
         self._in_step_loop = True
         try:
-            for batch in self._feed(train_ds):
+            for batch in self._feed_for_epoch(train_ds):
                 step_start = time.perf_counter_ns()
                 self.state, metrics = self._train_step_fn(self.state, batch)
                 step_end = time.perf_counter_ns()
+                _journal.emit(
+                    "step_dispatch", step_start / 1e9, step_end / 1e9, step=steps_done + 1
+                )
 
                 if not deferred:
-                    with self._stall.measure():  # eager path: per-step readback
+                    with self._stall.measure(label="metric_readback"):  # eager per-step readback
                         metrics = jax.device_get(metrics)
                 for mname, mval in metrics.items():
                     self.track_reduce(mname, mval)
@@ -1396,6 +1476,8 @@ class TrainValStage(Stage):
         if steps_done:
             self.track("misc/train_step_avg_ms", train_elapsed / steps_done * 1e3, prefixed=False)
             flops = float(self.step_flops())
+            if flops <= 0 and self._telemetry_armed:
+                flops = self._cost_analysis_flops()
             if flops > 0:
                 from .utils.profiling import peak_flops_for_kind
 
@@ -1419,7 +1501,7 @@ class TrainValStage(Stage):
 
         for name, schedule in self.pipeline.schedulers.items():
             if self.state is not None:
-                with self._stall.measure():
+                with self._stall.measure(label="metric_readback"):
                     step_count = int(jax.device_get(self.state.step))
             else:
                 step_count = 0
@@ -1440,10 +1522,10 @@ class TrainValStage(Stage):
 
         deferred = bool(self.deferred_metrics())
         last_metrics = None
-        for batch in self._feed(val_ds):
+        for batch in self._feed_for_epoch(val_ds):
             metrics = self._val_step_fn(self.state, batch)
             if not deferred:
-                with self._stall.measure():  # eager path: per-step readback
+                with self._stall.measure(label="metric_readback"):  # eager per-step readback
                     metrics = jax.device_get(metrics)
             for mname, mval in metrics.items():
                 self.track_reduce(mname, mval)

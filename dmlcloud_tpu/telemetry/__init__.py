@@ -1,0 +1,54 @@
+"""Flight recorder & goodput telemetry (doc/observability.md).
+
+Three parts, armed together via ``TrainingPipeline(telemetry=True|dir)``:
+
+- **Span journal** (``journal.py``): a low-overhead per-host JSONL journal of
+  typed spans (``data_wait``, ``h2d``, ``step_dispatch``, ``metric_readback``,
+  ``checkpoint``, ``barrier``, ``compile``, ``epoch``, stage/run lifecycle)
+  appended to an in-memory ring and flushed off-thread — the per-rank event
+  trace MegaScale (arXiv 2402.15627) credits most of its debugging wins to.
+  ``python -m dmlcloud_tpu timeline <run_dir>`` merges every rank's journal
+  into one Perfetto/Chrome-trace JSON.
+- **Goodput/MFU ledger** (``goodput.py``): wall-time decomposition into
+  compile / data-wait / checkpoint / host-stall / productive buckets per
+  epoch and per run, reduced across hosts on the packed metric collective
+  (``misc/goodput``, ``misc/mfu``), plus a root-only end-of-run table — the
+  PaLM-style (arXiv 2204.02311) headline efficiency number.
+- **Hang watchdog + flight recorder** (``watchdog.py``): a per-host heartbeat
+  that, when span/step progress stops (or on an uncaught exception), dumps
+  all-thread stacks, the last-N spans, and the barrier arrival state to
+  ``forensics/rank<k>.json`` — a post-mortem with the stuck rank named
+  instead of a silent Slurm kill.
+
+Everything here is stdlib-only at import time (no jax), so the journal can
+be read and converted on any machine.
+"""
+
+from . import goodput, journal, watchdog
+from .goodput import GoodputLedger, ledger_from_tracker
+from .journal import (
+    SCHEMA_VERSION,
+    SPAN_KINDS,
+    SpanJournal,
+    active_journal,
+    load_journals,
+    span,
+    to_chrome_trace,
+)
+from .watchdog import HangWatchdog
+
+__all__ = [
+    "goodput",
+    "journal",
+    "watchdog",
+    "GoodputLedger",
+    "ledger_from_tracker",
+    "SCHEMA_VERSION",
+    "SPAN_KINDS",
+    "SpanJournal",
+    "active_journal",
+    "load_journals",
+    "span",
+    "to_chrome_trace",
+    "HangWatchdog",
+]

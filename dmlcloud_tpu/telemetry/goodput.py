@@ -1,0 +1,177 @@
+"""Goodput/MFU ledger: where did the wall clock actually go?
+
+Decomposes each epoch's wall time into disjoint buckets —
+
+- ``data_wait_s``   host blocked waiting for the next batch (timed around
+  the feed iterator's ``next()``),
+- ``ckpt_s``        checkpoint dispatch + commit waits (StallTimer spans
+  labeled ``checkpoint``),
+- ``stall_s``       every OTHER accounted host block (metric readbacks, the
+  epoch-end sync) — StallTimer total minus the checkpoint share,
+- ``productive_s``  the remainder: time the host spent dispatching compiled
+  steps while the device computed —
+
+so the buckets sum to ``epoch_s`` by construction, and
+``goodput = productive_s / epoch_s`` is the PaLM-style fraction of the run
+that was real training. Compile cost (``misc/compile_ms``, paid once per
+stage BEFORE the epoch window) appears as a run-level bucket.
+
+The per-epoch numbers ride the tracker (``misc/goodput``,
+``misc/data_wait_ms``, ``misc/ckpt_ms``), so cross-host reduction happens on
+the existing packed metric collective — this module only *reads* the reduced
+histories back out into a ledger (rows + totals + a root-only table).
+
+MFU comes from ``Stage.step_flops()`` when declared, else (when the AOT
+registry holds a compiled executable) from XLA's own cost analysis —
+``flops_from_compiled`` — against ``chip_peak_flops()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["GoodputLedger", "ledger_from_tracker", "flops_from_compiled"]
+
+#: tracker metric -> ledger column (values in ms except goodput/mfu)
+_EPOCH_METRICS = {
+    "misc/epoch_time": "epoch_s",
+    "misc/data_wait_ms": "data_wait_s",
+    "misc/ckpt_ms": "ckpt_s",
+    "misc/host_stall_ms": "stall_total_s",
+    "misc/goodput": "goodput",
+    "misc/mfu": "mfu",
+}
+
+
+def _get(tracker, name: str, epoch_idx: int) -> float | None:
+    if name not in tracker:
+        return None
+    hist = tracker[name]
+    if epoch_idx >= len(hist) or hist[epoch_idx] is None:
+        return None
+    return float(hist[epoch_idx])
+
+
+class GoodputLedger:
+    """Per-epoch rows + run totals of the wall-time decomposition."""
+
+    def __init__(self, rows: list[dict], compile_s: float = 0.0):
+        self.rows = rows
+        self.compile_s = float(compile_s)
+
+    # -- aggregation ---------------------------------------------------------
+    def totals(self) -> dict:
+        def s(key: str) -> float:
+            return sum(r[key] or 0.0 for r in self.rows)
+
+        epoch_s = s("epoch_s")
+        out = {
+            "epochs": len(self.rows),
+            "wall_s": round(epoch_s + self.compile_s, 3),
+            "compile_s": round(self.compile_s, 3),
+            "data_wait_s": round(s("data_wait_s"), 3),
+            "ckpt_s": round(s("ckpt_s"), 3),
+            "host_stall_s": round(s("stall_s"), 3),
+            "productive_s": round(s("productive_s"), 3),
+        }
+        total = epoch_s + self.compile_s
+        out["goodput_frac"] = round(s("productive_s") / total, 4) if total > 0 else None
+        mfus = [r["mfu"] for r in self.rows if r.get("mfu") is not None]
+        out["mfu"] = round(sum(mfus) / len(mfus), 4) if mfus else None
+        return out
+
+    def to_dict(self) -> dict:
+        return {"v": 1, "epochs": self.rows, "totals": self.totals()}
+
+    # -- rendering -----------------------------------------------------------
+    def format_table(self) -> str:
+        """The root-only end-of-run table."""
+
+        def fmt(v: Any, pct_of: float | None = None) -> str:
+            if v is None:
+                return "-"
+            if pct_of:
+                return f"{v:8.2f} ({v / pct_of * 100:4.1f}%)"
+            return f"{v:8.2f}"
+
+        lines = [
+            "goodput ledger (seconds; productive = epoch - data_wait - ckpt - host_stall)",
+            f"{'epoch':>6}{'epoch_s':>10}{'data_wait':>11}{'ckpt':>9}{'host_stall':>12}"
+            f"{'productive':>12}{'goodput':>9}{'mfu':>7}",
+        ]
+        for r in self.rows:
+            gp = f"{r['goodput'] * 100:7.1f}%" if r.get("goodput") is not None else "      -"
+            mfu = f"{r['mfu'] * 100:5.1f}%" if r.get("mfu") is not None else "    -"
+            lines.append(
+                f"{r['epoch']:>6}{fmt(r['epoch_s']):>10}{fmt(r['data_wait_s']):>11}"
+                f"{fmt(r['ckpt_s']):>9}{fmt(r['stall_s']):>12}{fmt(r['productive_s']):>12}"
+                f"{gp:>9}{mfu:>7}"
+            )
+        t = self.totals()
+        gp = f"{t['goodput_frac'] * 100:.1f}%" if t["goodput_frac"] is not None else "-"
+        mfu = f"{t['mfu'] * 100:.1f}%" if t["mfu"] is not None else "-"
+        lines.append(
+            f"total: {t['wall_s']:.2f}s wall = {t['compile_s']:.2f} compile + "
+            f"{t['data_wait_s']:.2f} data_wait + {t['ckpt_s']:.2f} ckpt + "
+            f"{t['host_stall_s']:.2f} host_stall + {t['productive_s']:.2f} productive"
+            f" | goodput {gp}, mfu {mfu}"
+        )
+        return "\n".join(lines)
+
+
+def ledger_from_tracker(tracker) -> GoodputLedger:
+    """Build the ledger from the (already cross-host-reduced) tracker
+    histories. Epochs that never tracked the telemetry metrics (telemetry
+    armed mid-run, resumed histories) get None buckets, not zeros."""
+    n_epochs = 0
+    for name in _EPOCH_METRICS:
+        if name in tracker:
+            n_epochs = max(n_epochs, len(tracker[name]))
+    rows: list[dict] = []
+    for i in range(n_epochs):
+        epoch_s = _get(tracker, "misc/epoch_time", i)
+        data_wait_ms = _get(tracker, "misc/data_wait_ms", i)
+        ckpt_ms = _get(tracker, "misc/ckpt_ms", i)
+        stall_ms = _get(tracker, "misc/host_stall_ms", i)
+        row: dict[str, Any] = {
+            "epoch": i + 1,
+            "epoch_s": round(epoch_s, 6) if epoch_s is not None else None,
+            "data_wait_s": round(data_wait_ms / 1e3, 6) if data_wait_ms is not None else None,
+            "ckpt_s": round(ckpt_ms / 1e3, 6) if ckpt_ms is not None else None,
+            "goodput": _get(tracker, "misc/goodput", i),
+            "mfu": _get(tracker, "misc/mfu", i),
+        }
+        # host_stall bucket excludes the checkpoint share (disjoint buckets)
+        if stall_ms is not None:
+            row["stall_s"] = round(max(stall_ms - (ckpt_ms or 0.0), 0.0) / 1e3, 6)
+        else:
+            row["stall_s"] = None
+        if epoch_s is not None:
+            used = (row["data_wait_s"] or 0.0) + (row["ckpt_s"] or 0.0) + (row["stall_s"] or 0.0)
+            row["productive_s"] = round(max(epoch_s - used, 0.0), 6)
+        else:
+            row["productive_s"] = None
+        rows.append(row)
+    compile_ms = 0.0
+    if "misc/compile_ms" in tracker:
+        compile_ms = sum(v for v in tracker["misc/compile_ms"] if v is not None)
+    return GoodputLedger(rows, compile_s=compile_ms / 1e3)
+
+
+def flops_from_compiled(compiled: Any, n_devices: int = 1) -> float | None:
+    """Whole-mesh FLOPs of one step from a compiled executable's own XLA cost
+    analysis (``Compiled.cost_analysis()``), or None when the backend does
+    not report it. The analysis counts the per-device program; under SPMD
+    every device runs it, hence ``* n_devices``."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):  # older jax returns one dict per device
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    if flops is None or not flops > 0:  # NaN/None/0 all mean "not reported"
+        return None
+    return float(flops) * int(n_devices)

@@ -42,41 +42,69 @@ class StallTimer:
         self._ns = 0
         self._depth = 0
         self._outer_t0 = 0
+        self._outer_label: str | None = None
+        #: label -> accumulated ns for spans measured with ``measure(label=)``
+        #: — how the goodput ledger splits checkpoint waits from metric
+        #: readbacks inside one total (telemetry/goodput.py)
+        self._label_ns: dict[str, int] = {}
 
     @contextmanager
-    def measure(self):
+    def measure(self, label: str | None = None):
         """Time a host-blocked span. Nesting-safe: a ``measure()`` (or
         ``block()``/``fetch()``) inside an outer ``measure()`` contributes
         nothing of its own — only the outermost span accumulates, so nested
-        blocks are never double-counted."""
+        blocks are never double-counted. ``label`` attributes the outermost
+        span to a named bucket (``label_ms``) and, when the telemetry
+        journal is armed, emits it as a typed span."""
         self._depth += 1
         if self._depth == 1:
             self._outer_t0 = time.perf_counter_ns()
+            self._outer_label = label
         try:
             yield
         finally:
             self._depth -= 1
             if self._depth == 0:
-                self._ns += time.perf_counter_ns() - self._outer_t0
+                t1 = time.perf_counter_ns()
+                dt = t1 - self._outer_t0
+                self._ns += dt
+                label = self._outer_label
+                if label is not None:
+                    self._label_ns[label] = self._label_ns.get(label, 0) + dt
+                    from ..telemetry import journal as _journal
 
-    def block(self, tree):
+                    if _journal.active_journal() is not None:
+                        kind = label if label in _journal.SPAN_KINDS else "host_stall"
+                        _journal.emit(
+                            kind,
+                            self._outer_t0 / 1e9,
+                            t1 / 1e9,
+                            label=None if kind == label else label,
+                        )
+
+    def block(self, tree, label: str | None = "metric_readback"):
         """``jax.block_until_ready`` under the timer (the epoch-end sync)."""
         import jax
 
-        with self.measure():
+        with self.measure(label=label):
             return jax.block_until_ready(tree)
 
-    def fetch(self, value):
+    def fetch(self, value, label: str | None = "metric_readback"):
         """Fetch ``value`` to host under the timer, returning a numpy array."""
-        with self.measure():
+        with self.measure(label=label):
             return np.asarray(value)
 
     @property
     def ms(self) -> float:
         return self._ns / 1e6
 
+    def label_ms(self, label: str) -> float:
+        """Accumulated ms of outermost spans measured under ``label``."""
+        return self._label_ns.get(label, 0) / 1e6
+
     def reset(self) -> None:
         self._ns = 0
+        self._label_ns.clear()
 
 
 @contextmanager
@@ -271,5 +299,14 @@ class StepTimer:
             "mean_ms": float(arr.mean()),
             "p50_ms": float(np.percentile(arr, 50)),
             "p95_ms": float(np.percentile(arr, 95)),
+            "p99_ms": float(np.percentile(arr, 99)),
             "max_ms": float(arr.max()),
+            "total_ms": float(arr.sum()),
         }
+
+    def reset(self) -> None:
+        """Forget all recorded intervals AND the last tick, so the next
+        ``tick()`` starts a fresh dispatch-to-dispatch sequence (no phantom
+        interval spanning the reset)."""
+        self._t.clear()
+        self._last = None

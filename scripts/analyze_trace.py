@@ -20,21 +20,53 @@ Requires tensorflow (baked into this image) for the xplane proto only.
 """
 
 import argparse
+import json
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from dmlcloud_tpu.utils.profiling import format_roofline, roofline
 
+#: bump when the --json object's shape changes (consumers pin on this)
+JSON_SCHEMA_VERSION = 1
 
-def main():
+
+def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace_dir", help="directory passed to jax.profiler.trace")
     ap.add_argument("--steps", type=int, default=30, help="timed steps inside the trace")
-    args = ap.parse_args()
+    ap.add_argument(
+        "--json", action="store_true",
+        help='machine-readable output: {"version", "steps", "peaks", "rows"}',
+    )
+    args = ap.parse_args(argv)
     peaks, rows = roofline(args.trace_dir, steps=args.steps)
-    print(format_roofline(peaks, rows))
+    if not rows:
+        # a device plane with zero op events: the traced region dispatched no
+        # device work (trace() wrapped host-only code, or the steps never ran)
+        print(
+            f"analyze_trace: trace under {args.trace_dir} contains no XLA op rows — "
+            "the traced region executed no device work. Wrap actual train steps "
+            "in profiling.trace() and block_until_ready before closing it.",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": JSON_SCHEMA_VERSION,
+                    "steps": args.steps,
+                    "peaks": peaks,
+                    "rows": rows,
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        print(format_roofline(peaks, rows))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""DML108 bad fixture: wall-clock ``time.time()`` used for step timing in
+step/epoch code — NTP slews/steps it, corrupting span durations.
+
+Static lint corpus — never imported or executed.
+"""
+
+import time
+
+import jax
+
+
+class TimerStage(TrainValStage):  # noqa: F821 — corpus, never executed
+    def train_epoch(self):
+        epoch_t0 = time.time()  # BAD: wall clock for a duration
+        for batch in self.batches:
+            t0 = time.time_ns()  # BAD: wall clock for step timing
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            self.track("step_ms", (time.time_ns() - t0) / 1e6)  # BAD
+        self._stall.block(metrics)
+        self.track("epoch_s", time.time() - epoch_t0)  # BAD
+
+
+@jax.jit
+def step(state, batch):
+    started = time.time()  # BAD: wall clock inside a traced step
+    return state, {"t": started}

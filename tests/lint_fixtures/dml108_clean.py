@@ -1,0 +1,22 @@
+"""DML108 clean fixture: monotonic clocks in step/epoch code; wall clock
+only outside the hazard contexts (human-readable logging, dir naming).
+
+Static lint corpus — never imported or executed.
+"""
+
+import time
+
+
+class TimerStage(TrainValStage):  # noqa: F821 — corpus, never executed
+    def train_epoch(self):
+        epoch_t0 = time.perf_counter()  # monotonic: NTP cannot move it
+        for batch in self.batches:
+            t0 = time.perf_counter_ns()
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            self.track("step_ms", (time.perf_counter_ns() - t0) / 1e6)
+        self._stall.block(metrics)
+        self.track("epoch_s", time.perf_counter() - epoch_t0)
+
+
+def checkpoint_name(prefix):  # not step/epoch code: wall clock is fine here
+    return f"{prefix}-{int(time.time())}"

@@ -35,6 +35,7 @@ BAD_EXPECT = {
     "DML105": 2,
     "DML106": 2,
     "DML107": 3,
+    "DML108": 5,
 }
 
 
