@@ -20,12 +20,26 @@ DML105      blocking ``checkpoint.save``/``wandb`` calls inside the epoch
             loop — serialization/network on the training thread
 DML106      wall-clock timing of dispatches without ``block_until_ready``
             — benchmarks that measure enqueue cost, not execution
+DML107      ``jax.jit``/``pjit`` call inside a loop body — re-traces and
+            re-compiles every iteration
+DML108      ``time.time()`` for step timing — NTP steps corrupt durations
+DML201      collective ``axis_name`` that no mesh declares (resolved
+            through assignments and across files — flow-aware)
+DML202      ``shard_map`` spec arity / unknown ``PartitionSpec`` axis
+DML203      collective in host-side code outside any trace context
+DML204      value read again after ``donate_argnums`` donated its buffers
+DML301      shared attribute locked on one side of a thread boundary only
+DML302      ``time.sleep`` polling loop where an Event/Condition exists
 ==========  ============================================================
 
 Entry points: ``lint_source``/``lint_file``/``lint_paths`` (library),
-``python -m dmlcloud_tpu lint`` (CLI), ``TrainingPipeline(lint="warn")``
-(runtime, lints registered Stage subclasses at run start). Suppress a
-finding with ``# dmllint: disable=DML101 -- justification``. Full catalog
+``python -m dmlcloud_tpu lint`` (CLI; ``--format=github``, ``--jobs N``),
+``TrainingPipeline(lint="warn")`` (lints registered Stage subclasses at
+run start), and ``TrainingPipeline(sanitize="warn"|"error")`` — the
+runtime sanitizer arm (lint/sanitize.py): implicit-transfer probes +
+``jax_debug_nans`` reporting through the same Finding schema and the
+telemetry journal. Suppress a finding with ``# dmllint: disable=DML101 --
+justification`` (family wildcards like ``DML2xx`` work). Full catalog
 with bad/good examples: doc/lint.md.
 """
 
@@ -33,11 +47,15 @@ from .engine import (  # noqa: F401
     Finding,
     LintError,
     RULES,
+    build_project_context,
     lint_file,
     lint_paths,
     lint_source,
 )
 from . import rules  # noqa: F401  — importing registers the rules
+from . import rules_sharding  # noqa: F401  — DML2xx sharding/collective family
+from . import rules_concurrency  # noqa: F401  — DML3xx concurrency family
+from .sanitize import SANITIZE_MODES, Sanitizer, SanitizerError  # noqa: F401
 from .traceguard import RetraceError, TraceGuard  # noqa: F401
 
 __all__ = [
@@ -45,7 +63,11 @@ __all__ = [
     "LintError",
     "RULES",
     "RetraceError",
+    "SANITIZE_MODES",
+    "Sanitizer",
+    "SanitizerError",
     "TraceGuard",
+    "build_project_context",
     "lint_file",
     "lint_paths",
     "lint_source",

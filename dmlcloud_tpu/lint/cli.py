@@ -1,7 +1,8 @@
 """``python -m dmlcloud_tpu lint`` — the CLI front end.
 
 Human output is one ``path:line:col: RULE message`` per finding (clickable
-in editors/CI logs); ``--json`` emits one stable machine-readable object::
+in editors/CI logs); ``--format=json`` (or the ``--json`` shorthand) emits
+one stable machine-readable object::
 
     {
       "version": 1,
@@ -9,6 +10,12 @@ in editors/CI logs); ``--json`` emits one stable machine-readable object::
       "findings": [{"rule", "path", "line", "col", "message", "context"}...],
       "counts": {"DML101": 2}
     }
+
+``--format=github`` emits GitHub Actions workflow commands
+(``::error file=...,line=...::``) so findings annotate the PR diff inline —
+``scripts/lint_gate.sh`` wires this as the CI gate. ``--jobs N`` fans the
+scan over a process pool (findings stay in deterministic path order).
+``--select``/``--ignore`` take exact ids and ``DML2xx`` family wildcards.
 
 Exit codes: 0 clean, 1 findings, 2 usage error. Pure stdlib — no jax
 import, safe to run anywhere (pre-commit hooks, CPU-only CI).
@@ -20,37 +27,55 @@ import argparse
 import json
 import sys
 
-from .engine import RULES, lint_file, iter_python_files
+from .engine import RULES, expand_rule_ids, iter_python_files, lint_paths
 
 
 def _parse_ids(spec: str) -> list[str]:
     ids = [p.strip() for p in spec.split(",") if p.strip()]
-    unknown = [i for i in ids if i not in RULES]
+    expanded, unknown = expand_rule_ids(ids)
     if unknown:
         raise argparse.ArgumentTypeError(
-            f"unknown rule id(s) {', '.join(unknown)}; known: {', '.join(sorted(RULES))}"
+            f"unknown rule id(s)/family wildcard(s) {', '.join(unknown)}; "
+            f"known: {', '.join(sorted(RULES))} (families like DML2xx work too)"
         )
-    return ids
+    return expanded
+
+
+def _github_escape(msg: str) -> str:
+    """GitHub workflow commands are line-oriented; data is %-escaped."""
+    return msg.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dmlcloud_tpu lint",
-        description="AST-based TPU-hazard linter enforcing the overlap engine's "
-        "sync-point contract (doc/lint.md).",
+        description="Flow-aware TPU-hazard linter enforcing the overlap engine's "
+        "sync-point contract and the sharding/concurrency contracts (doc/lint.md).",
     )
     parser.add_argument(
         "paths", nargs="*", default=["."],
         help="files and/or directories to lint recursively (default: .)",
     )
-    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"), default=None,
+        help="output format: text (default), json (stable schema v1), or "
+        "github (GitHub Actions ::error annotations)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="shorthand for --format=json"
+    )
     parser.add_argument(
         "--select", type=_parse_ids, default=None, metavar="IDS",
-        help="comma-separated rule ids to run (default: all)",
+        help="comma-separated rule ids or families (DML2xx) to run (default: all)",
     )
     parser.add_argument(
         "--ignore", type=_parse_ids, default=None, metavar="IDS",
-        help="comma-separated rule ids to skip",
+        help="comma-separated rule ids or families (DML2xx) to skip",
+    )
+    parser.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="lint files on N worker processes (default 1: serial, deterministic "
+        "output either way)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
@@ -61,19 +86,36 @@ def main(argv=None) -> int:
         # argparse exits 2 on usage errors already; normalize --help's 0
         return int(e.code or 0)
 
+    if args.format is not None and args.json and args.format != "json":
+        print("lint: --json conflicts with --format", file=sys.stderr)
+        return 2
+    fmt = args.format or ("json" if args.json else "text")
+    if args.jobs < 1:
+        print(f"lint: --jobs must be >= 1, got {args.jobs}", file=sys.stderr)
+        return 2
+
     if args.list_rules:
         for rid in sorted(RULES):
             print(f"{rid}  {RULES[rid].title}")
         return 0
 
-    findings = []
-    files_scanned = 0
-    for fpath in iter_python_files(args.paths):
-        files_scanned += 1
-        findings.extend(lint_file(fpath, select=args.select, ignore=args.ignore))
-    findings.sort(key=lambda f: f.sort_key())
+    files_scanned = sum(1 for _ in iter_python_files(args.paths))
+    findings = lint_paths(args.paths, select=args.select, ignore=args.ignore, jobs=args.jobs)
 
-    if args.json:
+    try:
+        _emit(fmt, findings, files_scanned)
+    except BrokenPipeError:
+        # `lint ... | head` closed the pipe: still exit with the real status
+        # (stdout redirected to devnull so the interpreter's exit flush
+        # doesn't raise again)
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 1 if findings else 0
+
+
+def _emit(fmt: str, findings, files_scanned: int) -> None:
+    if fmt == "json":
         counts: dict[str, int] = {}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1
@@ -88,6 +130,17 @@ def main(argv=None) -> int:
                 sort_keys=True,
             )
         )
+    elif fmt == "github":
+        for f in findings:
+            print(
+                f"::error file={f.path},line={f.line},col={f.col},"
+                f"title={f.rule}::{_github_escape(f.message)}"
+            )
+        noun = "file" if files_scanned == 1 else "files"
+        print(
+            f"::notice::dmlcloud_tpu lint: {len(findings)} finding(s) in "
+            f"{files_scanned} {noun} scanned"
+        )
     else:
         for f in findings:
             print(f.format())
@@ -96,7 +149,6 @@ def main(argv=None) -> int:
             print(f"{len(findings)} finding(s) in {files_scanned} {noun} scanned")
         else:
             print(f"clean: {files_scanned} {noun} scanned, 0 findings")
-    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -41,14 +41,18 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator
 
+from . import dataflow
+
 __all__ = [
     "Finding",
     "LintError",
     "RULES",
     "rule",
+    "expand_rule_ids",
     "lint_source",
     "lint_file",
     "lint_paths",
+    "build_project_context",
     "ModuleCtx",
     "FnCtx",
 ]
@@ -127,6 +131,31 @@ def rule(rule_id: str, title: str):
     return deco
 
 
+def _id_matches(rule_id: str, spec: str) -> bool:
+    """Whether ``spec`` selects ``rule_id``: exact id, ``all``, or a family
+    wildcard like ``DML2xx`` (trailing ``xx`` matches any digits)."""
+    if spec == "all" or spec == rule_id:
+        return True
+    if spec.endswith("xx") and len(spec) > 2:
+        return rule_id.startswith(spec[:-2])
+    return False
+
+
+def expand_rule_ids(ids: Iterable[str]) -> tuple[list[str], list[str]]:
+    """Expand exact ids and ``DML2xx`` family wildcards against the
+    registry. Returns ``(expanded, unknown)`` — a wildcard matching nothing
+    and an unregistered exact id both land in ``unknown``."""
+    expanded: list[str] = []
+    unknown: list[str] = []
+    for spec in ids:
+        matched = [rid for rid in sorted(RULES) if _id_matches(rid, spec)]
+        if matched:
+            expanded.extend(m for m in matched if m not in expanded)
+        else:
+            unknown.append(spec)
+    return expanded, unknown
+
+
 # --------------------------------------------------------------- suppressions
 
 _DIRECTIVE = re.compile(
@@ -144,7 +173,8 @@ class Suppressions:
 
     def is_suppressed(self, finding: Finding) -> bool:
         ids = self.by_line.get(finding.line, set()) | self.file_wide
-        return finding.rule in ids or "all" in ids
+        # family wildcards (``disable=DML2xx``) suppress the whole family
+        return any(_id_matches(finding.rule, spec) for spec in ids)
 
     @classmethod
     def parse(cls, source: str) -> "Suppressions":
@@ -198,12 +228,27 @@ class JitSite:
 
 
 class ModuleCtx:
-    """Everything the rules need about one parsed module."""
+    """Everything the rules need about one parsed module. ``project`` is the
+    optional cross-file :class:`dataflow.ProjectContext` a ``lint_paths``
+    run shares between modules (mesh axes declared anywhere legitimise
+    collectives everywhere)."""
 
-    def __init__(self, path: str, source: str, tree: ast.Module):
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        tree: ast.Module,
+        project: "dataflow.ProjectContext | None" = None,
+        axes_only: bool = False,
+    ):
+        """``axes_only`` builds just what the project axis pass needs
+        (aliases, bindings, parents) and skips hazard-context discovery —
+        pass 1 of ``lint_paths`` runs over every file, so its cost is the
+        serial fraction of a ``--jobs`` scan."""
         self.path = path
         self.source = source
         self.tree = tree
+        self.project = project
         self.aliases = _collect_aliases(tree)
         self.step_fns: list[FnCtx] = []
         self.epoch_fns: list[FnCtx] = []
@@ -212,7 +257,64 @@ class ModuleCtx:
         #: ``self._train_step = jax.jit(...)``, decorated defs) — DML106's
         #: notion of "this call dispatches device work"
         self.jitted_names: set[str] = set()
-        self._collect()
+        #: names (incl. dotted ``self.f`` chains) bound to jitted callables
+        #: with donated args -> set of donated positional indexes (DML204)
+        self.donating_names: dict[str, set[int]] = {}
+        #: ``shard_map``/``shard_map_compat`` call sites (DML202) and the
+        #: function defs provably wrapped by one (DML201/DML203 context)
+        self.shard_map_calls: list[ast.Call] = []
+        self.shard_mapped_defs: set[ast.AST] = set()
+        #: child -> parent for every node (scope lookups for the dataflow
+        #: rules; built once, O(module size))
+        self.parents: dict[ast.AST, ast.AST] = {
+            child: parent for parent in ast.walk(tree) for child in ast.iter_child_nodes(parent)
+        }
+        #: module-scope bindings (dataflow.Bindings); per-function bindings
+        #: are computed lazily and cached in _fn_bindings
+        self.bindings = dataflow.module_bindings(tree)
+        self._fn_bindings: dict[ast.AST, dataflow.Bindings] = {}
+        if not axes_only:
+            self._collect()
+        #: axis names this module provably declares (needs bindings+parents)
+        self.declared_axes: set[str] = dataflow.collect_declared_axes(tree, self)
+
+    # -- scopes (dataflow) --------------------------------------------------
+    def enclosing_function(self, node: ast.AST) -> ast.AST | None:
+        """The nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_functions(self, node: ast.AST) -> list[ast.AST]:
+        """All enclosing function defs, innermost first."""
+        out = []
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            out.append(fn)
+            fn = self.enclosing_function(fn)
+        return out
+
+    def fn_bindings(self, fn: ast.AST) -> "dataflow.Bindings":
+        if fn not in self._fn_bindings:
+            self._fn_bindings[fn] = dataflow.function_bindings(fn)
+        return self._fn_bindings[fn]
+
+    def scopes_at(self, node: ast.AST) -> list["dataflow.Bindings"]:
+        """The binding-scope chain at ``node``: enclosing functions
+        innermost-first, then the module scope."""
+        return [self.fn_bindings(fn) for fn in self.enclosing_functions(node)] + [self.bindings]
+
+    def known_axes(self) -> set[str]:
+        """Every mesh axis name considered declared for this module: the
+        framework vocabulary, this module's declarations, and (when linting
+        a whole tree) every other scanned module's."""
+        axes = set(dataflow.BUILTIN_AXES) | self.declared_axes
+        if self.project is not None:
+            axes |= self.project.declared_axes
+        return axes
 
     # -- name resolution ----------------------------------------------------
     def resolve(self, node: ast.AST) -> str | None:
@@ -263,13 +365,44 @@ class ModuleCtx:
         # names bound to jit(...) results: f = jax.jit(...), self.f = jax.jit(...)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if self._jit_call_kwargs(node.value) is None:
+                kwargs = self._jit_call_kwargs(node.value)
+                if kwargs is None:
                     continue
+                donated = _donated_argnums(kwargs)
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Name):
                         self.jitted_names.add(tgt.id)
+                        if donated:
+                            self.donating_names[tgt.id] = donated
                     elif isinstance(tgt, ast.Attribute):
                         self.jitted_names.add(tgt.attr)
+                        if donated:
+                            self.donating_names[".".join(attr_chain(tgt))] = donated
+
+        # calls to a @jit(donate_argnums=...)-decorated def donate too
+        for node, kwargs in jitted_defs.items():
+            donated = _donated_argnums(kwargs)
+            if donated and getattr(node, "name", None):
+                self.donating_names.setdefault(node.name, donated)
+
+        # shard_map / shard_map_compat sites and the defs they wrap
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.resolve(node.func) or ""
+            last = resolved.split(".")[-1] if resolved else ""
+            if not last and isinstance(node.func, ast.Attribute):
+                last = node.func.attr
+            if last not in ("shard_map", "shard_map_compat"):
+                continue
+            self.shard_map_calls.append(node)
+            if node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    for d in defs_by_name.get(target.id, []):
+                        self.shard_mapped_defs.add(d)
+                elif isinstance(target, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.shard_mapped_defs.add(target)
 
         # Stage-class step/epoch methods
         for node in ast.walk(self.tree):
@@ -394,6 +527,20 @@ def _static_params(fn, jit_kwargs: dict[str, ast.expr]) -> set[str]:
     return statics
 
 
+def _donated_argnums(jit_kwargs: dict[str, ast.expr]) -> set[int]:
+    """Positional indexes a jit call donates (``donate_argnums`` int/tuple
+    literals). ``donate_argnames`` cannot be mapped to positions without the
+    signature, so it contributes nothing here — DML204 stays silent rather
+    than mis-attributing a donation."""
+    donated: set[int] = set()
+    kw = jit_kwargs.get("donate_argnums")
+    if kw is not None:
+        for c in ast.walk(kw):
+            if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                donated.add(c.value)
+    return donated
+
+
 def _compute_taint(fn, seeds: set[str]) -> set[str]:
     """Forward taint: ``seeds`` plus every name assigned from an expression
     referencing a tainted name, to a fixpoint. Coarse by design — the rules
@@ -494,9 +641,11 @@ def lint_source(
     path: str = "<string>",
     select: Iterable[str] | None = None,
     ignore: Iterable[str] | None = None,
+    project: "dataflow.ProjectContext | None" = None,
 ) -> list[Finding]:
     """Lint one module's source. Returns findings sorted by location, with
-    suppression comments already applied."""
+    suppression comments already applied. ``select``/``ignore`` accept exact
+    rule ids and ``DML2xx`` family wildcards."""
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -509,10 +658,10 @@ def lint_source(
                 f"could not parse file: {e.msg}",
             )
         ]
-    ctx = ModuleCtx(path, source, tree)
+    ctx = ModuleCtx(path, source, tree, project=project)
     sup = Suppressions.parse(source)
-    selected = set(select) if select else set(RULES)
-    ignored = set(ignore) if ignore else set()
+    selected = set(expand_rule_ids(select)[0]) if select else set(RULES)
+    ignored = set(expand_rule_ids(ignore)[0]) if ignore else set()
     out: set[Finding] = set()
     for info in RULES.values():
         if info.id not in selected or info.id in ignored:
@@ -527,6 +676,7 @@ def lint_file(
     path: str | os.PathLike,
     select: Iterable[str] | None = None,
     ignore: Iterable[str] | None = None,
+    project: "dataflow.ProjectContext | None" = None,
 ) -> list[Finding]:
     path = os.fspath(path)
     try:
@@ -534,7 +684,7 @@ def lint_file(
             source = f.read()
     except OSError as e:
         return [Finding(PARSE_ERROR_RULE, path, 1, 0, f"could not read file: {e}")]
-    return lint_source(source, path=path, select=select, ignore=ignore)
+    return lint_source(source, path=path, select=select, ignore=ignore, project=project)
 
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", ".hg", ".venv", "venv", "node_modules", "build", "dist", ".eggs"})
@@ -554,13 +704,61 @@ def iter_python_files(paths: Iterable[str | os.PathLike]) -> Iterator[str]:
             yield p
 
 
+def build_project_context(files: Iterable[str | os.PathLike]) -> "dataflow.ProjectContext":
+    """Pass 1 of a multi-file lint: parse every file and union its declared
+    mesh axes into one :class:`dataflow.ProjectContext`. Unreadable or
+    unparseable files contribute nothing here — pass 2 reports them."""
+    project = dataflow.ProjectContext()
+    for fpath in files:
+        try:
+            with open(os.fspath(fpath), "r", encoding="utf-8", errors="replace") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue
+        ctx = ModuleCtx(os.fspath(fpath), source, tree, axes_only=True)
+        project.merge_module(ctx.declared_axes)
+    return project
+
+
+def _lint_file_task(args: tuple) -> list[Finding]:
+    """Top-level worker for the --jobs process pool (must be picklable).
+    Re-imports register the rules in the child; the project context arrives
+    as a plain axes set."""
+    path, select, ignore, axes = args
+    from . import rules, rules_concurrency, rules_sharding  # noqa: F401 — register rules
+
+    project = dataflow.ProjectContext(declared_axes=set(axes))
+    return lint_file(path, select=select, ignore=ignore, project=project)
+
+
 def lint_paths(
     paths: Iterable[str | os.PathLike],
     select: Iterable[str] | None = None,
     ignore: Iterable[str] | None = None,
+    jobs: int = 1,
+    project: "dataflow.ProjectContext | None" = None,
 ) -> list[Finding]:
-    """Lint files and/or directories (recursive); returns sorted findings."""
+    """Lint files and/or directories (recursive); returns sorted findings.
+
+    Runs in two passes: pass 1 collects the project-wide mesh-axis registry
+    (so DML2xx rules see axes declared in *other* files), pass 2 runs the
+    rules. ``jobs > 1`` fans pass 2 out over a ``ProcessPoolExecutor``;
+    findings merge in path order either way, so output is deterministic."""
+    files = list(iter_python_files(paths))
+    if project is None:
+        project = build_project_context(files)
     findings: list[Finding] = []
-    for fpath in iter_python_files(paths):
-        findings.extend(lint_file(fpath, select=select, ignore=ignore))
+    if jobs > 1 and len(files) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+
+        select_t = tuple(select) if select else None
+        ignore_t = tuple(ignore) if ignore else None
+        tasks = [(f, select_t, ignore_t, frozenset(project.declared_axes)) for f in files]
+        with ProcessPoolExecutor(max_workers=jobs) as pool:
+            for file_findings in pool.map(_lint_file_task, tasks):
+                findings.extend(file_findings)
+    else:
+        for fpath in files:
+            findings.extend(lint_file(fpath, select=select, ignore=ignore, project=project))
     return sorted(findings, key=Finding.sort_key)

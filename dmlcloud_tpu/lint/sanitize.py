@@ -1,0 +1,263 @@
+"""The runtime sanitizer — the dynamic arm of the lint subsystem.
+
+DML101 can flag a lexical ``np.asarray(metrics["loss"])``; it cannot see a
+conversion hidden behind a helper, a batch that skipped ``device_put`` and
+transfers implicitly at dispatch, or a NaN born three layers into a jitted
+step. ``TrainingPipeline(sanitize="warn"|"error")`` catches those at
+runtime, on CPU, mirroring :class:`~dmlcloud_tpu.lint.traceguard.TraceGuard`
+(the DML104 runtime companion): wrap the framework's own boundaries, watch,
+report through the same :class:`~dmlcloud_tpu.lint.engine.Finding` schema,
+and emit ``sanitizer`` spans on the telemetry journal when it is armed.
+
+Three probes, one reporting path:
+
+- **implicit device-to-host** (pseudo-rule ``DML401``): a Python-level
+  probe over ``ArrayImpl.__array__`` — the hook every ``np.asarray``/
+  ``jax.device_get`` of a multi-device array funnels through — active only
+  on the guarded thread, only inside the stage's epoch window, and never
+  inside a sanctioned block (``StallTimer.measure/fetch/block`` — the same
+  exemption the static DML101 grants). jax's own
+  ``transfer_guard_device_to_host`` is skipped deliberately: XLA's CPU
+  backend aliases host memory and never consults it, so the Python probe is
+  what makes the contract testable where CI runs.
+- **implicit host-to-device** (``DML402``): the step-dispatch wrapper scans
+  the call's pytree leaves for host ``np.ndarray``\\ s (a batch that skipped
+  the feed path's explicit ``device_put`` — a per-step blocking transfer on
+  real hardware), and in ``error`` mode additionally dispatches under
+  ``jax.transfer_guard_host_to_device("disallow")`` so anything the scan
+  can't see still raises.
+- **non-finite values** (``DML403``): ``error`` mode arms jax's
+  ``jax_debug_nans`` for the epoch window — every dispatch is checked and a
+  NaN raises ``FloatingPointError`` at the op that produced it, not three
+  epochs later in a loss curve. (In ``warn`` mode the existing
+  ``nan_guard()`` machinery already reports at log boundaries; debug_nans
+  has no non-raising mode, so arming it would turn warn into error.)
+
+``warn`` reports each violation site once (log + journal + finding) and
+lets execution continue — on CPU the conversion is cheap, the point is the
+report. ``error`` raises :class:`SanitizerError` at the violation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+
+from .engine import Finding
+
+__all__ = ["SANITIZE_MODES", "Sanitizer", "SanitizerError", "sanctioned"]
+
+SANITIZE_MODES = ("off", "warn", "error")
+
+#: runtime pseudo-rules (reported through the Finding schema, documented in
+#: doc/lint.md, never emitted by the static pass — like DML999)
+RULE_D2H = "DML401"
+RULE_H2D = "DML402"
+RULE_NONFINITE = "DML403"
+
+_logger = logging.getLogger("dmlcloud_tpu.lint.sanitize")
+
+_tls = threading.local()
+
+
+class SanitizerError(RuntimeError):
+    """A sanitize="error" run hit a violation; carries it on ``.findings``."""
+
+    def __init__(self, message: str, findings: list[Finding] | None = None):
+        super().__init__(message)
+        self.findings = findings or []
+
+
+@contextmanager
+def sanctioned():
+    """Mark the enclosed block as an *accounted* host sync — the runtime
+    twin of the static linter's ``with <x>.measure():`` exemption.
+    ``StallTimer`` wraps every measured span in this; the D2H probe never
+    fires inside. Reentrant, per-thread, and near-free when the sanitizer
+    is off (one thread-local increment)."""
+    _tls.sanctioned = getattr(_tls, "sanctioned", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.sanctioned -= 1
+
+
+# --------------------------------------------------------------- D2H probe
+#
+# Installed at most once per process, globally over ArrayImpl.__array__;
+# dormant (one thread-local read) unless the calling thread is inside an
+# armed epoch guard. Left installed after the run — uninstalling under
+# concurrent conversions would race.
+
+_probe_installed = False
+_orig_array = None
+
+
+def _install_probe() -> None:
+    global _probe_installed, _orig_array
+    if _probe_installed:
+        return
+    from jax._src import array as _array_mod
+
+    _orig_array = _array_mod.ArrayImpl.__array__
+
+    def probed_array(self, *args, **kwargs):
+        san = getattr(_tls, "active", None)
+        if san is not None and not getattr(_tls, "sanctioned", 0):
+            san._on_d2h()
+        return _orig_array(self, *args, **kwargs)
+
+    _array_mod.ArrayImpl.__array__ = probed_array
+    _probe_installed = True
+
+
+def _caller_site() -> tuple[str, int]:
+    """(path, line) of the nearest stack frame outside jax/numpy/this
+    package — the user statement that triggered the conversion."""
+    import sys
+
+    frame = sys._getframe(2)
+    while frame is not None:
+        fname = frame.f_code.co_filename
+        low = fname.replace("\\", "/")
+        if not any(seg in low for seg in ("/jax/", "/jaxlib/", "/jax_", "/numpy/", "/lint/sanitize", "/contextlib")):
+            return fname, frame.f_lineno
+        frame = frame.f_back
+    return "<unknown>", 0
+
+
+class Sanitizer:
+    """Per-pipeline runtime sanitizer; see the module docstring.
+
+    One instance lives on the pipeline for the whole run; ``epoch_guard``
+    activates it around each stage's ``run_epoch`` and ``wrap_dispatch``
+    interposes on the compiled step callables (both no-ops when off)."""
+
+    def __init__(self, mode: str = "off", logger: logging.Logger | None = None):
+        if mode not in SANITIZE_MODES:
+            raise ValueError(f"sanitize must be one of {SANITIZE_MODES}, got {mode!r}")
+        self.mode = mode
+        self.logger = logger or _logger
+        #: every violation reported this run (Finding schema, v1 fields)
+        self.findings: list[Finding] = []
+        self._seen: set[tuple[str, str, int]] = set()
+        self._stage = ""
+
+    @property
+    def armed(self) -> bool:
+        return self.mode != "off"
+
+    # -- reporting -----------------------------------------------------------
+    def _record(self, rule_id: str, path: str, line: int, message: str) -> Finding | None:
+        """Dedupe, journal, log; returns the Finding (None when already
+        reported for this site)."""
+        key = (rule_id, path, line)
+        if key in self._seen:
+            return None
+        self._seen.add(key)
+        finding = Finding(rule_id, path, line, 0, message, context=self._stage)
+        self.findings.append(finding)
+        from ..telemetry import journal as _journal
+
+        t = _journal.now()
+        _journal.emit("sanitizer", t, t, label=rule_id, rule=rule_id, path=path, line=line, stage=self._stage)
+        if self.mode == "warn":
+            self.logger.warning("sanitizer: %s", finding.format())
+        return finding
+
+    def _violation(self, rule_id: str, path: str, line: int, message: str) -> None:
+        finding = self._record(rule_id, path, line, message)
+        if self.mode == "error":
+            f = finding or Finding(rule_id, path, line, 0, message, context=self._stage)
+            raise SanitizerError(
+                f"sanitize=\"error\": {f.format()} (doc/lint.md: runtime sanitizer)",
+                [f],
+            )
+
+    def _on_d2h(self) -> None:
+        path, line = _caller_site()
+        # no recursion into jax while handling jax's own conversion
+        with sanctioned():
+            self._violation(
+                RULE_D2H, path, line,
+                "implicit device-to-host transfer (np.asarray/float on a device "
+                "value) outside any StallTimer-accounted block: it blocks the "
+                "dispatch queue on real hardware. Fetch via StallTimer.fetch() "
+                "or defer to the epoch-end reduce",
+            )
+
+    # -- guard windows -------------------------------------------------------
+    @contextmanager
+    def epoch_guard(self, stage: str = ""):
+        """Activate the sanitizer for one ``run_epoch`` on this thread.
+        ``error`` mode also arms ``jax_debug_nans`` for the window; a
+        ``FloatingPointError`` surfacing from it is recorded (journal +
+        findings) and re-raised unchanged."""
+        if not self.armed:
+            yield
+            return
+        _install_probe()
+        import jax
+
+        self._stage = stage
+        debug_nans_prev = None
+        if self.mode == "error":
+            debug_nans_prev = bool(jax.config.jax_debug_nans)
+            jax.config.update("jax_debug_nans", True)
+        prev_active = getattr(_tls, "active", None)
+        _tls.active = self
+        try:
+            yield
+        except FloatingPointError as e:
+            path, line = _caller_site()
+            self._record(
+                RULE_NONFINITE, path, line,
+                f"non-finite value under jax_debug_nans: {e}",
+            )
+            raise
+        finally:
+            _tls.active = prev_active
+            if debug_nans_prev is not None:
+                jax.config.update("jax_debug_nans", debug_nans_prev)
+
+    def wrap_dispatch(self, fn, where: str = ""):
+        """Interpose on a compiled step callable (TraceGuard-style): scan
+        the call's leaves for host ``np.ndarray``\\ s — a batch that skipped
+        the feed path's explicit ``device_put`` and would transfer
+        implicitly, blocking every step — and, in ``error`` mode, dispatch
+        under jax's native ``transfer_guard_host_to_device("log")`` so
+        anything the scan can't see (scalar promotion, weak types) leaves an
+        XLA-level breadcrumb on stderr. The native guard stays at "log", not
+        "disallow": ``jax_debug_nans``'s deoptimized re-run (and legitimate
+        eager scalar math) performs implicit transfers by design, and a
+        disallow here would mask the FloatingPointError with a transfer
+        error. Returns ``fn`` unchanged when off."""
+        if not self.armed:
+            return fn
+        import jax
+        import numpy as np
+
+        sanitizer = self
+
+        def dispatch(*args, **kwargs):
+            host = [
+                leaf
+                for leaf in jax.tree_util.tree_leaves((args, kwargs))
+                if isinstance(leaf, np.ndarray) and leaf.size > 0
+            ]
+            if host:
+                path, line = _caller_site()
+                sanitizer._violation(
+                    RULE_H2D, path, line,
+                    f"{where or 'step'} dispatched with {len(host)} host numpy "
+                    "leaf/leaves: each one is an implicit host-to-device "
+                    "transfer blocking the step. Route batches through the feed "
+                    "path (device_iterator / make_global_batch)",
+                )
+            if sanitizer.mode == "error":
+                with jax.transfer_guard_host_to_device("log"):
+                    return fn(*args, **kwargs)
+            return fn(*args, **kwargs)
+
+        return dispatch

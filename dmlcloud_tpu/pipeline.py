@@ -56,6 +56,7 @@ class TrainingPipeline:
         config: Any = None,
         name: Optional[str] = None,
         lint: Optional[str] = None,
+        sanitize: Optional[str] = None,
         compile_cache: Any = None,
         precompile: bool = False,
         buckets: Any = None,
@@ -67,6 +68,18 @@ class TrainingPipeline:
         work happens. None (default) skips linting — the CLI
         (``python -m dmlcloud_tpu lint``) and the self-lint test remain the
         review-time nets.
+
+        ``sanitize`` arms the RUNTIME sanitizer (dmlcloud_tpu.lint.sanitize)
+        — the dynamic companion of the static pass: each stage's epoch runs
+        under a device-to-host conversion probe (implicit ``np.asarray`` of
+        a device value outside a StallTimer-accounted block), step dispatch
+        is checked for host numpy leaves (an implicit host-to-device
+        transfer), and ``"error"`` additionally arms jax's
+        ``transfer_guard`` + ``jax_debug_nans`` for the window. ``"warn"``
+        reports each violation site once (log + ``sanitizer`` telemetry
+        span + ``pipeline.sanitizer_findings``) and continues; ``"error"``
+        raises ``lint.SanitizerError`` at the violation. None/``"off"``
+        (default) changes nothing — not even a context manager enters.
 
         The cold-start killers (dmlcloud_tpu.compile; doc/performance.md §4):
 
@@ -96,9 +109,14 @@ class TrainingPipeline:
         instrumentation points reduce to one attribute read."""
         if lint not in (None, "warn", "error"):
             raise ValueError(f'lint must be None, "warn" or "error", got {lint!r}')
+        if sanitize not in (None, "off", "warn", "error"):
+            raise ValueError(f'sanitize must be None, "off", "warn" or "error", got {sanitize!r}')
         self.config: Config = as_config(config)
         self.name = name
         self._lint_mode = lint
+        from .lint.sanitize import Sanitizer
+
+        self._sanitizer = Sanitizer(sanitize or "off", logger=logging.getLogger("dmlcloud_tpu"))
         self._compile_cache = compile_cache
         self._compile_cache_dir: str | None = None
         self._precompile = bool(precompile)
@@ -150,6 +168,12 @@ class TrainingPipeline:
     def telemetry_armed(self) -> bool:
         """True between telemetry arming at run start and teardown."""
         return self._journal is not None
+
+    @property
+    def sanitizer_findings(self):
+        """Violations the runtime sanitizer recorded this run (Finding
+        schema; empty when ``sanitize`` is off or nothing tripped)."""
+        return list(self._sanitizer.findings)
 
     def set_mesh(self, mesh_or_axes) -> None:
         """Set the device mesh (a ``jax.sharding.Mesh`` or an axes dict like

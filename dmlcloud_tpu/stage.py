@@ -176,7 +176,11 @@ class Stage:
         self._pre_stage()
         while not self._stop_requested and (self.max_epochs is None or self.current_epoch <= self.max_epochs):
             self._pre_epoch()
-            self.run_epoch()
+            # the runtime sanitizer's guard window is exactly one epoch:
+            # everything inside may not do unaccounted implicit transfers;
+            # the epoch-end reduce below (_post_epoch) is outside on purpose
+            with self._sanitizer_guard():
+                self.run_epoch()
             if getattr(self, "_mid_epoch_exit", False):
                 # a step-granular save already persisted the state and a
                 # coordinated preemption cut the epoch short: exit WITHOUT
@@ -201,6 +205,15 @@ class Stage:
                 )
                 break
         self._post_stage()
+
+    def _sanitizer_guard(self):
+        """The pipeline sanitizer's epoch window, or a no-op when off."""
+        san = getattr(self.pipeline, "_sanitizer", None)
+        if san is not None and san.armed:
+            return san.epoch_guard(stage=self.name or type(self).__name__)
+        from contextlib import nullcontext
+
+        return nullcontext()
 
     def _pre_stage(self):
         self.start_time = datetime.now()
@@ -801,6 +814,13 @@ class TrainValStage(Stage):
         self._train_step_fn = self._build_train_step()
         self._val_step_fn = self._build_val_step()
         self._setup_compiled_steps()
+        san = getattr(self.pipeline, "_sanitizer", None)
+        if san is not None and san.armed:
+            # the sanitizer's dispatch probe (host-numpy leaves == implicit
+            # H2D) interposes OUTSIDE TraceGuard/PrecompiledStep so the
+            # default path gains zero overhead when sanitize is off
+            self._train_step_fn = san.wrap_dispatch(self._train_step_fn, where=f"{self.name}.train_step")
+            self._val_step_fn = san.wrap_dispatch(self._val_step_fn, where=f"{self.name}.val_step")
 
     # -- cold-start machinery (compile/; doc/performance.md §4) -------------
     def _setup_compiled_steps(self):

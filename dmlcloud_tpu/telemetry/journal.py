@@ -69,6 +69,7 @@ SPAN_KINDS = frozenset(
         "compile",  # AOT precompile of one signature
         "host_stall",  # any other accounted host block (StallTimer)
         "watchdog",  # forensics dump events
+        "sanitizer",  # runtime sanitizer violations (lint/sanitize.py)
     }
 )
 

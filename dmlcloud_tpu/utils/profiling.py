@@ -55,13 +55,21 @@ class StallTimer:
         nothing of its own — only the outermost span accumulates, so nested
         blocks are never double-counted. ``label`` attributes the outermost
         span to a named bucket (``label_ms``) and, when the telemetry
-        journal is armed, emits it as a typed span."""
+        journal is armed, emits it as a typed span.
+
+        Measured spans are also *sanctioned* for the runtime sanitizer
+        (lint/sanitize.py) — the same exemption the static DML101 rule
+        grants ``with <x>.measure():`` blocks: an accounted sync is the
+        framework's own pattern, never a violation."""
+        from ..lint.sanitize import sanctioned
+
         self._depth += 1
         if self._depth == 1:
             self._outer_t0 = time.perf_counter_ns()
             self._outer_label = label
         try:
-            yield
+            with sanctioned():
+                yield
         finally:
             self._depth -= 1
             if self._depth == 0:

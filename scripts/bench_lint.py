@@ -1,0 +1,73 @@
+"""Bench receipt for the linter itself: lint_wall_s of a full self-lint
+run (dmlcloud_tpu/ + examples/ + bench.py + scripts/), serial vs --jobs.
+
+The lint gate runs on every CI invocation and every pre-commit hook — its
+cost is part of the perf trajectory like any hot path, so it gets a
+receipt (BENCH_lint_pr05.json) the same way compile/overlap wins do.
+
+    python scripts/bench_lint.py [-o BENCH_lint_pr05.json] [--jobs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlcloud_tpu.lint import lint_paths  # noqa: E402
+from dmlcloud_tpu.lint.engine import iter_python_files  # noqa: E402
+
+TARGETS = ["dmlcloud_tpu", "examples", "bench.py", "scripts"]
+
+
+def _time_lint(paths, jobs: int, repeats: int = 3) -> tuple[float, int]:
+    """Best-of-N wall seconds (best-of filters scheduler noise the same way
+    bench.py's step timers do) and the finding count of the last run."""
+    best = float("inf")
+    findings = 0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = lint_paths(paths, jobs=jobs)
+        best = min(best, time.perf_counter() - t0)
+        findings = len(result)
+    return best, findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("-o", "--output", default=os.path.join(REPO, "BENCH_lint_pr05.json"))
+    parser.add_argument("--jobs", type=int, default=max(2, min(os.cpu_count() or 2, 8)))
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    paths = [os.path.join(REPO, t) for t in TARGETS]
+    files = sum(1 for _ in iter_python_files(paths))
+    serial_s, findings = _time_lint(paths, jobs=1, repeats=args.repeats)
+    jobs_s, _ = _time_lint(paths, jobs=args.jobs, repeats=args.repeats)
+
+    receipt = {
+        "bench": "lint_selflint",
+        "targets": TARGETS,
+        "files_scanned": files,
+        "findings": findings,
+        "repeats_best_of": args.repeats,
+        "lint_wall_s": round(serial_s, 4),
+        "lint_wall_s_jobs": round(jobs_s, 4),
+        "jobs": args.jobs,
+        "speedup": round(serial_s / jobs_s, 3) if jobs_s > 0 else None,
+        "rules": "DML1xx + DML2xx + DML3xx (flow-aware engine, project axis registry)",
+    }
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(receipt, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(receipt, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# CI lint gate: the whole framework, the examples, the bench harness, and
+# the scripts must satisfy the contracts the linter enforces (doc/lint.md).
+# --format=github makes each finding an inline PR annotation on GitHub
+# Actions; locally the same command prints ::error lines and exits 1.
+#
+# Usage: scripts/lint_gate.sh [extra lint args, e.g. --jobs 4]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m dmlcloud_tpu lint dmlcloud_tpu examples bench.py scripts --format=github "$@"

@@ -1,0 +1,33 @@
+"""DML201 bad fixture: collective axis names no mesh declares.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu.parallel.mesh import create_mesh
+
+mesh = create_mesh({"data": -1})
+
+
+@jax.jit
+def reduce_fn(x):
+    return jax.lax.psum(x, "dta")  # BAD: typo'd axis, no mesh declares it
+
+
+@jax.jit
+def mean_fn(x):
+    ax = "nope"
+    return jax.lax.pmean(x, ax)  # BAD: resolved through the assignment
+
+
+@jax.jit
+def gather_fn(x):
+    return jax.lax.all_gather(x, ("data", "typo"))  # BAD: one of the tuple
+
+
+def body(x):
+    return jax.lax.psum(x)  # BAD: no axis_name inside a shard_map body
+
+
+wrapped = jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)

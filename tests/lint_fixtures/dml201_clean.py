@@ -1,0 +1,42 @@
+"""DML201 clean fixture: declared axes, aliased axis names, unresolvable
+axis parameters (never guessed at), and the framework vocabulary.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu.parallel.mesh import DATA, create_mesh, parse_mesh_axes
+
+# axes declared through one level of assignment — the dataflow pass, not a
+# string literal at the call site
+axes = {"data": -1, "rows": 2}
+mesh = create_mesh(axes)
+spec_axes = parse_mesh_axes("cols=4,depth=-1")
+
+
+@jax.jit
+def reduce_fn(x):
+    return jax.lax.psum(x, "rows")  # fine: declared via the axes dict
+
+
+@jax.jit
+def mean_fn(x):
+    return jax.lax.pmean(x, "cols")  # fine: declared via parse_mesh_axes
+
+
+@jax.jit
+def const_fn(x):
+    return jax.lax.psum(x, DATA)  # fine: the framework axis constant
+
+
+def library_helper(x, axis_name):
+    # fine: the axis is a parameter — unresolvable, never guessed at
+    return jax.lax.psum(x, axis_name)
+
+
+def body(x):
+    return jax.lax.psum(x, "data")  # fine: named axis inside shard_map
+
+
+wrapped = jax.shard_map(body, mesh=mesh, in_specs=None, out_specs=None)

@@ -1,0 +1,17 @@
+"""DML203 bad fixture: collectives in provably-host contexts.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+import jnp_stub as jnp  # stand-in; fixture is never executed
+
+total = jax.lax.psum(jnp.ones(3), "data")  # BAD: module level, no trace
+
+
+class HostSyncStage(TrainValStage):  # noqa: F821 — corpus file
+    def train_epoch(self):
+        for batch in self.ds:
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            grad_sum = jax.lax.pmean(metrics, "data")  # BAD: epoch loop
+            self.track_reduce("g", grad_sum)

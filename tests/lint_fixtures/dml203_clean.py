@@ -1,0 +1,34 @@
+"""DML203 clean fixture: collectives in legitimate trace contexts, and
+library helpers that are merely *called* from traced code.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu.parallel.mesh import create_mesh
+
+mesh = create_mesh({"data": -1})
+
+
+@jax.jit
+def step(state, batch):
+    return jax.lax.psum(batch, "data")  # fine: jitted step context
+
+
+def shard_body(x):
+    return jax.lax.pmean(x, "data")  # fine: shard_map body
+
+
+wrapped = jax.shard_map(shard_body, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def ring_helper(x, axis_name="data"):
+    # fine: a plain helper — callers wrap it in shard_map (the
+    # ring_attention pattern); flagging it would ban library code
+    return jax.lax.ppermute(x, axis_name, [(0, 1)])
+
+
+class FineStage(TrainValStage):  # noqa: F821 — corpus file
+    def step(self, state, batch):
+        return jax.lax.pmean(batch, "data")  # fine: traced step method

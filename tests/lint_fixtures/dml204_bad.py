@@ -1,0 +1,33 @@
+"""DML204 bad fixture: donated values read after the jitted call.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+
+def update(state, batch):
+    return state
+
+
+train = jax.jit(update, donate_argnums=0)
+
+
+def loop_no_rebind(state, batches):
+    for b in batches:
+        new_state, metrics = train(state, b)  # BAD: donated, never rebound
+    return new_state
+
+
+def read_after_donate(state, batch):
+    new_state = train(state, batch)
+    log(state)  # BAD: state's buffers were donated on the line above
+    return new_state
+
+
+def read_before_rebind(state, batches):
+    for b in batches:
+        nxt = train(state, b)
+        delta = diff(state, nxt)  # BAD: read between donate and rebind
+        state = nxt
+    return state

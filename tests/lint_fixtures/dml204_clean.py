@@ -1,0 +1,37 @@
+"""DML204 clean fixture: the donation idioms that are safe.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+
+def update(state, batch):
+    return state
+
+
+train = jax.jit(update, donate_argnums=0)
+undonated = jax.jit(update)
+
+
+def rebind_same_statement(state, batches):
+    for b in batches:
+        state = train(state, b)  # fine: the canonical donate idiom
+    return state
+
+
+def donate_then_done(state, batch):
+    return train(state, batch)  # fine: never read again
+
+
+def no_donation(state, batches):
+    for b in batches:
+        out = undonated(state, b)  # fine: nothing donated
+        check(state, out)
+    return state
+
+
+def rebound_before_read(state, batch):
+    state = train(state, batch)
+    log(state)  # fine: reads the NEW state
+    return state

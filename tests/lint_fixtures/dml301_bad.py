@@ -1,0 +1,42 @@
+"""DML301 bad fixture: shared attributes locked on one side of a thread
+boundary only.
+
+Static lint corpus — never imported or executed.
+"""
+
+import threading
+
+
+class FlusherInconsistent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                batch, self._pending = self._pending, []
+
+    def emit(self, rec):
+        self._pending.append(rec)  # BAD: thread side locks, this doesn't
+
+
+class WriterInconsistent:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._buf = []
+
+    def start(self):
+        threading.Thread(target=self._drain).start()
+
+    def _drain(self):
+        self._buf = []  # BAD: foreground side locks, this doesn't
+
+    def push(self, item):
+        with self._mutex:
+            self._buf.append(item)

@@ -1,0 +1,53 @@
+"""DML301 clean fixture: consistent locking, deliberate lock-free
+protocols, and happens-before ``__init__`` writes.
+
+Static lint corpus — never imported or executed.
+"""
+
+import threading
+
+
+class FlusherConsistent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []  # fine: __init__ happens-before Thread.start
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                batch, self._pending = self._pending, []
+
+    def emit(self, rec):
+        with self._lock:
+            self._pending.append(rec)  # fine: same lock as the thread side
+
+
+class HeartbeatLockFree:
+    """A monotonic heartbeat written bare from both sides — a deliberate
+    benign race (watchdog pattern); neither side locks, so no finding."""
+
+    def __init__(self):
+        self.last = 0.0
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        self.last = 1.0
+
+    def notify(self):
+        self.last = 2.0
+
+
+class NoThreads:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self.value += 1  # fine: no thread boundary in this class

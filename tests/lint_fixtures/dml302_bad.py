@@ -1,0 +1,27 @@
+"""DML302 bad fixture: sleep-polling loops beside an unused Event.
+
+Static lint corpus — never imported or executed.
+"""
+
+import threading
+import time
+
+
+class SleepPoller:
+    def __init__(self):
+        self._stop = threading.Event()
+        self.done = False
+
+    def _loop(self):
+        while not self.done:
+            time.sleep(0.2)  # BAD: self._stop.wait(0.2) wakes immediately
+
+
+class CondPoller:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def wait_ready(self):
+        while not self.ready:
+            time.sleep(0.05)  # BAD: the Condition models exactly this

@@ -1,0 +1,35 @@
+"""DML302 clean fixture: event waits, and sleeps that aren't polling a
+state an Event models.
+
+Static lint corpus — never imported or executed.
+"""
+
+import threading
+import time
+
+
+class EventWaiter:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def _loop(self):
+        while not self._stop.wait(0.2):  # fine: wakes on set()
+            self.work()
+
+
+class PlainRetry:
+    """No Event/Condition on this object — a sleep-retry loop may be the
+    only tool it has (e.g. polling an external service)."""
+
+    def poll(self):
+        while not self.server_ready():
+            time.sleep(1.0)  # fine: nothing here models readiness
+
+
+class OneShotSleep:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def settle(self):
+        time.sleep(0.1)  # fine: not a polling loop
+        return self._stop.is_set()

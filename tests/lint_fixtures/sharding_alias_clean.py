@@ -1,0 +1,47 @@
+"""Dedicated aliasing fixture (acceptance: DML201/DML202 must resolve axis
+names through at least one level of assignment/aliasing — not just string
+literals at the call site). Everything here is CLEAN because every axis
+name reaches its use through an assignment chain the dataflow pass follows.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from dmlcloud_tpu.parallel.mesh import DATA, create_mesh
+
+# one level: dict literal -> name -> create_mesh
+axes = {"data": -1, "heads": 4}
+mesh = create_mesh(axes)
+
+# two levels: the resolver chases bounded chains
+base_axes = {"stages": 2}
+renamed = base_axes
+pipe_mesh = create_mesh(renamed)
+
+
+@jax.jit
+def head_reduce(x):
+    ax = "heads"
+    return jax.lax.psum(x, ax)  # fine: 'heads' declared via the axes alias
+
+
+@jax.jit
+def stage_reduce(x):
+    return jax.lax.pmean(x, "stages")  # fine: declared two hops away
+
+
+@jax.jit
+def const_reduce(x):
+    axis = DATA
+    return jax.lax.psum(x, axis)  # fine: framework constant through a name
+
+
+def body(a, b):
+    return a + b
+
+
+# specs through an assignment: the tuple literal never appears at the call
+specs = (P("heads"), P(None))
+wrapped = jax.shard_map(body, mesh=mesh, in_specs=specs, out_specs=P("data"))

@@ -36,6 +36,12 @@ BAD_EXPECT = {
     "DML106": 2,
     "DML107": 3,
     "DML108": 5,
+    "DML201": 4,
+    "DML202": 3,
+    "DML203": 2,
+    "DML204": 3,
+    "DML301": 2,
+    "DML302": 2,
 }
 
 
@@ -201,6 +207,169 @@ class TestCLI:
 
     def test_unknown_rule_id_is_usage_error(self, capsys):
         assert lint_cli([str(FIXTURES), "--select", "DML777"]) == 2
+
+    def test_github_format(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml201_bad.py"), "--format=github"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        lines = [l for l in out.splitlines() if l.startswith("::error")]
+        assert len(lines) == BAD_EXPECT["DML201"]
+        assert lines[0].startswith("::error file=")
+        assert ",line=" in lines[0] and "title=DML201::" in lines[0]
+        assert "::notice::" in out
+
+    def test_github_format_clean(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml201_clean.py"), "--format=github"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "::error" not in out and "0 finding(s)" in out
+
+    def test_json_flag_is_format_shorthand(self, capsys):
+        lint_cli([str(FIXTURES / "dml101_bad.py"), "--format=json"])
+        via_format = capsys.readouterr().out
+        lint_cli([str(FIXTURES / "dml101_bad.py"), "--json"])
+        via_flag = capsys.readouterr().out
+        assert via_format == via_flag
+
+    def test_conflicting_formats_rejected(self, capsys):
+        assert lint_cli([str(FIXTURES), "--json", "--format=github"]) == 2
+
+    def test_jobs_flag(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml201_bad.py"), "--jobs", "2", "--json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["counts"] == {"DML201": BAD_EXPECT["DML201"]}
+        assert lint_cli([str(FIXTURES), "--jobs", "0"]) == 2
+
+    def test_select_family_wildcard_cli(self, capsys):
+        rc = lint_cli([str(FIXTURES / "dml301_bad.py"), "--select", "DML3xx", "--json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["counts"]) == {"DML301"}
+
+
+class TestDataflowAliasing:
+    """Acceptance: DML201/DML202 resolve axis names through at least one
+    level of assignment/aliasing — not just literals at the call site."""
+
+    def test_alias_fixture_is_clean(self):
+        assert lint_file(FIXTURES / "sharding_alias_clean.py") == []
+
+    def test_axis_through_assignment_flags_unknown(self):
+        src = (
+            "import jax\n"
+            "from dmlcloud_tpu.parallel.mesh import create_mesh\n"
+            'axes = {"data": -1, "rows": 2}\n'
+            "mesh = create_mesh(axes)\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            '    ax = "cols"\n'
+            "    return jax.lax.psum(x, ax)\n"
+        )
+        assert [f.rule for f in lint_source(src)] == ["DML201"]
+        # and the axis the alias chain DOES declare is accepted
+        assert lint_source(src.replace('"cols"', '"rows"')) == []
+
+    def test_spec_tuple_through_assignment(self):
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "from dmlcloud_tpu.parallel.mesh import create_mesh\n"
+            "def body(a, b):\n"
+            "    return a + b\n"
+            'mesh = create_mesh({"data": 8})\n'
+            "specs = (P('data'),)\n"
+            "f = jax.shard_map(body, mesh=mesh, in_specs=specs, out_specs=P())\n"
+        )
+        findings = lint_source(src)
+        assert [f.rule for f in findings] == ["DML202"], [f.format() for f in findings]
+        assert "2 positional argument" in findings[0].message
+
+    def test_unresolvable_axis_never_guessed(self):
+        src = (
+            "import jax\n"
+            "def helper(x, axis_name):\n"
+            "    return jax.lax.psum(x, axis_name)\n"
+        )
+        assert lint_source(src) == []
+
+    def test_local_mesh_literal_beats_builtin_vocabulary(self):
+        # 'model' is in the framework vocabulary, but THIS shard_map's mesh
+        # provably has only 'data' — flow beats vocabulary
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "from dmlcloud_tpu.parallel.mesh import create_mesh\n"
+            "def body(a):\n"
+            "    return a\n"
+            'mesh = create_mesh({"data": 8})\n'
+            "f = jax.shard_map(body, mesh=mesh, in_specs=(P('model'),), out_specs=P())\n"
+        )
+        assert [f.rule for f in lint_source(src)] == ["DML202"]
+
+
+class TestProjectRegistry:
+    """Mesh axes declared in one file legitimise collectives in another
+    when linted together (lint_paths' two-pass project context)."""
+
+    def test_cross_file_axis_declaration(self, tmp_path):
+        (tmp_path / "meshes.py").write_text(
+            "from dmlcloud_tpu.parallel.mesh import create_mesh\n"
+            'mesh = create_mesh({"data": -1, "widgets": 4})\n'
+        )
+        (tmp_path / "ops.py").write_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            '    return jax.lax.psum(x, "widgets")\n'
+        )
+        assert lint_paths([tmp_path]) == []
+        # alone, ops.py cannot know about 'widgets'
+        assert [f.rule for f in lint_paths([tmp_path / "ops.py"])] == ["DML201"]
+
+    def test_jobs_parallel_matches_serial(self, tmp_path):
+        serial = lint_paths([FIXTURES])
+        parallel = lint_paths([FIXTURES], jobs=2)
+        assert [f.format() for f in parallel] == [f.format() for f in serial]
+
+
+class TestWildcards:
+    """Family wildcards (DML2xx) in suppression comments and selection, and
+    their interaction — acceptance for the suppression/selection satellite."""
+
+    BAD_AXIS = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        '    return jax.lax.psum(x, "bogus")\n'
+    )
+
+    def test_family_wildcard_suppression(self):
+        src = self.BAD_AXIS.replace(
+            'jax.lax.psum(x, "bogus")',
+            'jax.lax.psum(x, "bogus")  # dmllint: disable=DML2xx -- staged mesh',
+        )
+        assert lint_source(src) == []
+        # the wildcard covers its own family only
+        assert [f.rule for f in lint_source(self.BAD_AXIS, select=["DML2xx"])] == ["DML201"]
+
+    def test_file_wide_directive_beats_select(self):
+        # --select DML201 must NOT resurrect a finding the file disabled
+        src = "# dmllint: disable-file=DML201\n" + self.BAD_AXIS
+        assert lint_source(src, select=["DML201"]) == []
+
+    def test_select_family_wildcard(self):
+        bad = (FIXTURES / "dml201_bad.py").read_text()
+        assert {f.rule for f in lint_source(bad, select=["DML2xx"])} == {"DML201"}
+        assert lint_source(bad, select=["DML1xx"]) == []
+        assert lint_source(bad, ignore=["DML2xx"]) == []
+
+    def test_expand_rule_ids(self):
+        from dmlcloud_tpu.lint.engine import expand_rule_ids
+
+        expanded, unknown = expand_rule_ids(["DML3xx", "DML101", "DML9xx"])
+        assert expanded == ["DML301", "DML302", "DML101"]
+        assert unknown == ["DML9xx"]
 
 
 class TestTraceGuard:

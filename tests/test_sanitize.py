@@ -1,0 +1,197 @@
+"""Runtime sanitizer (lint/sanitize.py): TrainingPipeline(sanitize=...)
+must catch an injected implicit device-to-host transfer inside the step
+loop — raising in "error" mode, logging + journaling + continuing in
+"warn" mode, and doing literally nothing in the default "off" mode. The
+framework's own accounted sync points (StallTimer spans) stay sanctioned.
+"""
+
+import json
+import logging
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.lint import Sanitizer, SanitizerError
+from dmlcloud_tpu.lint.sanitize import RULE_D2H, RULE_H2D, RULE_NONFINITE, sanctioned
+
+
+class _SanStage(dml.TrainValStage):
+    """Linear-regression toy; subclasses inject violations."""
+
+    def pre_stage(self):
+        rng = np.random.RandomState(3)
+        xs = rng.randn(4, 16, 4).astype(np.float32)
+        batches = [{"x": x, "y": x.sum(axis=-1, keepdims=True)} for x in xs]
+        self.pipeline.register_model(
+            "linear",
+            apply_fn=lambda p, x: x @ p["w"],
+            params={"w": jnp.zeros((4, 1))},
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
+        self.pipeline.register_dataset("train", batches, verbose=False)
+
+    def step(self, state, batch):
+        pred = state.apply_fn(state.params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+
+class _LeakyStage(_SanStage):
+    """Injects one implicit D2H conversion per step — the DML101 hazard,
+    now caught at runtime."""
+
+    def train_epoch(self):
+        for batch in self._feed(self.train_dataset()):
+            self.state, metrics = self._train_step_fn(self.state, batch)
+            np.asarray(metrics["loss"])  # the injected implicit transfer
+
+
+def _pipeline(stage, mode, max_epochs=1, **kw):
+    p = dml.TrainingPipeline(sanitize=mode, **kw)
+    p.append_stage(stage, max_epochs=max_epochs, name="SanStage")
+    return p
+
+
+class TestModes:
+    def test_error_mode_raises_on_injected_d2h(self, single_runtime):
+        p = _pipeline(_LeakyStage(), "error")
+        with pytest.raises(SanitizerError) as exc:
+            p.run()
+        assert exc.value.findings and exc.value.findings[0].rule == RULE_D2H
+        assert "test_sanitize" in exc.value.findings[0].path
+
+    def test_warn_mode_logs_and_continues(self, single_runtime, caplog, tmp_path):
+        p = _pipeline(_LeakyStage(), "warn", max_epochs=2, telemetry=str(tmp_path))
+        with caplog.at_level(logging.WARNING, logger="dmlcloud_tpu"):
+            p.run()  # completes despite the per-step violation
+        assert any(RULE_D2H in r.getMessage() for r in caplog.records)
+        # one finding per SITE, not per step/epoch
+        assert [f.rule for f in p.sanitizer_findings] == [RULE_D2H]
+        # the violation rides the telemetry journal as a 'sanitizer' span
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "journal-rank0.jsonl").read_text().splitlines()
+        ]
+        spans = [r for r in records if r["kind"] == "sanitizer"]
+        assert spans and spans[0]["rule"] == RULE_D2H
+        assert spans[0]["line"] > 0
+
+    def test_off_mode_changes_nothing(self, single_runtime):
+        p = _pipeline(_LeakyStage(), None)
+        p.run()
+        assert p.sanitizer_findings == []
+
+    def test_clean_stage_passes_error_mode(self, single_runtime):
+        """The framework's own sync points (StallTimer fetch at log
+        boundaries, the epoch-end block) are sanctioned — a contract-clean
+        stage must run under sanitize="error" without a single finding."""
+        stage = _SanStage()
+        p = _pipeline(stage, "error", max_epochs=2)
+        p.run()
+        assert p.sanitizer_findings == []
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            dml.TrainingPipeline(sanitize="maybe")
+        with pytest.raises(ValueError):
+            Sanitizer("loud")
+
+
+class TestNanArm:
+    def test_error_mode_arms_debug_nans(self, single_runtime):
+        class NanStage(_SanStage):
+            def nan_guard(self):
+                return False  # isolate the sanitizer's debug_nans arm
+
+            def step(self, state, batch):
+                pred = state.apply_fn(state.params, batch["x"])
+                return jnp.log(-jnp.abs(pred.mean()) - 1.0)  # always NaN
+
+        p = _pipeline(NanStage(), "error")
+        with pytest.raises(FloatingPointError):
+            p.run()
+        assert [f.rule for f in p.sanitizer_findings] == [RULE_NONFINITE]
+        # the window restored the global flag
+        assert not jax.config.jax_debug_nans
+
+    def test_off_mode_does_not_arm_debug_nans(self, single_runtime):
+        class NanStage(_SanStage):
+            def nan_guard(self):
+                return False
+
+            def log_every(self):
+                return 0
+
+            def step(self, state, batch):
+                pred = state.apply_fn(state.params, batch["x"])
+                return jnp.log(-jnp.abs(pred.mean()) - 1.0)
+
+        p = _pipeline(NanStage(), None)
+        p.run()  # NaNs flow silently — exactly the default behavior
+        assert p.sanitizer_findings == []
+
+
+class TestDispatchProbe:
+    def test_host_numpy_leaves_flagged(self):
+        san = Sanitizer("error")
+        wrapped = san.wrap_dispatch(lambda b: b, where="test.step")
+        with pytest.raises(SanitizerError) as exc:
+            wrapped({"x": np.ones(4, np.float32)})
+        assert exc.value.findings[0].rule == RULE_H2D
+
+    def test_device_leaves_pass(self):
+        san = Sanitizer("warn")
+        wrapped = san.wrap_dispatch(lambda b: b, where="test.step")
+        wrapped({"x": jnp.ones(4)})
+        assert san.findings == []
+
+    def test_off_returns_fn_unchanged(self):
+        san = Sanitizer("off")
+        fn = lambda b: b  # noqa: E731
+        assert san.wrap_dispatch(fn) is fn
+
+
+def _sharded_value(mesh):
+    """A replicated multi-device array — the shape every pipeline metric
+    has (the probe's interception point; single-device CPU arrays alias
+    host memory and convert zero-copy, see lint/sanitize.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(jnp.ones(8).sum(), NamedSharding(mesh, P()))
+
+
+class TestSanctioned:
+    def test_sanctioned_block_suppresses_probe(self, mesh8):
+        """np.asarray inside a StallTimer measure()/fetch() is the
+        accounted idiom — the probe must not fire there."""
+        from dmlcloud_tpu.utils.profiling import StallTimer
+
+        san = Sanitizer("error")
+        value = _sharded_value(mesh8)
+        timer = StallTimer()
+        with san.epoch_guard(stage="t"):
+            timer.fetch(value)  # sanctioned: no raise
+            with sanctioned():
+                np.asarray(value)  # explicitly sanctioned: no raise
+        assert san.findings == []
+
+    def test_probe_fires_outside_sanction(self, mesh8):
+        san = Sanitizer("error")
+        value = _sharded_value(mesh8)
+        with pytest.raises(SanitizerError):
+            with san.epoch_guard(stage="t"):
+                np.asarray(value)
+
+    def test_probe_inactive_outside_guard(self, mesh8):
+        san = Sanitizer("error")
+        value = _sharded_value(mesh8)
+        np.asarray(value)  # no guard window: plain conversion
+        assert san.findings == []

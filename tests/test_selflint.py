@@ -1,16 +1,21 @@
-"""Self-lint gate (tier-1): the framework and its examples must satisfy the
-very contract the linter enforces — zero findings over ``dmlcloud_tpu/``
-and ``examples/``.
+"""Self-lint gate (tier-1): the framework, its examples, the bench harness,
+and the scripts must satisfy the very contracts the linter enforces — zero
+findings over ``dmlcloud_tpu/``, ``examples/``, ``bench.py``, ``scripts/``,
+with ALL rule families enabled (sync-point DML1xx, sharding DML2xx,
+concurrency DML3xx).
 
 This is the CI tripwire the lint subsystem exists for: a future Stage
 subclass, example, or hot-loop edit that reintroduces a host sync, an
-undonated train step, or a retrace hazard fails HERE, on CPU, at review
-time — not three PRs later on a chip. Legitimate exceptions carry a
-``# dmllint: disable=...`` with a justification (see stage.py's eager
-bisection path for the canonical one).
+undonated train step, a typo'd mesh axis, or a half-locked thread protocol
+fails HERE, on CPU, at review time — not three PRs later on a chip.
+Legitimate exceptions carry a ``# dmllint: disable=...`` with a
+justification (see stage.py's eager bisection path for the canonical one).
+``scripts/lint_gate.sh`` runs the same scan as a GitHub-annotating CI step.
 """
 
 from pathlib import Path
+
+import pytest
 
 import dmlcloud_tpu
 from dmlcloud_tpu.lint import lint_paths
@@ -34,11 +39,23 @@ def test_package_lints_clean():
 def test_examples_lint_clean():
     examples = REPO_ROOT / "examples"
     if not examples.is_dir():  # installed-package runs have no examples tree
-        import pytest
-
         pytest.skip("examples/ not present next to the package")
     findings = lint_paths([examples])
     assert findings == [], (
         f"examples/ violate the sync-point contract:\n{_report(findings)}\n"
         "Examples are copied verbatim by users — they must model the contract."
+    )
+
+
+def test_bench_and_scripts_lint_clean():
+    """bench.py and scripts/ produce the numbers the perf claims rest on —
+    a dishonest timing loop or a donated-buffer read THERE corrupts the
+    receipts, so they sit under the same gate as the framework."""
+    targets = [p for p in (REPO_ROOT / "bench.py", REPO_ROOT / "scripts") if p.exists()]
+    if not targets:  # installed-package runs carry neither
+        pytest.skip("bench.py / scripts/ not present next to the package")
+    findings = lint_paths(targets)
+    assert findings == [], (
+        f"bench.py / scripts/ violate the lint contract:\n{_report(findings)}\n"
+        "Fix the hazard or suppress it with '# dmllint: disable=ID -- why'."
     )

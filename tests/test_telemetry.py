@@ -42,7 +42,7 @@ from dmlcloud_tpu.telemetry.goodput import flops_from_compiled
 V1_KINDS = {
     "run", "stage", "epoch", "step_dispatch", "data_wait", "h2d",
     "metric_readback", "checkpoint", "barrier", "compile", "host_stall",
-    "watchdog",
+    "watchdog", "sanitizer",
 }
 
 #: Core fields every v1 record carries, with their types.
