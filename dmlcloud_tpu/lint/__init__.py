@@ -28,6 +28,11 @@ DML201      collective ``axis_name`` that no mesh declares (resolved
 DML202      ``shard_map`` spec arity / unknown ``PartitionSpec`` axis
 DML203      collective in host-side code outside any trace context
 DML204      value read again after ``donate_argnums`` donated its buffers
+DML205      jitted train/decode step returns an updated state/KV-cache
+            argument without donating it — the buffer is held twice
+            (flow-aware: read-only consumers stay silent)
+DML206      ``lax.scan``/``nn.scan`` over a layer stack without a remat
+            policy — activation memory grows with depth
 DML301      shared attribute locked on one side of a thread boundary only
 DML302      ``time.sleep`` polling loop where an Event/Condition exists
 ==========  ============================================================
@@ -54,6 +59,7 @@ from .engine import (  # noqa: F401
 )
 from . import rules  # noqa: F401  — importing registers the rules
 from . import rules_sharding  # noqa: F401  — DML2xx sharding/collective family
+from . import rules_perf  # noqa: F401  — DML205/206 donation & remat contracts
 from . import rules_concurrency  # noqa: F401  — DML3xx concurrency family
 from .sanitize import SANITIZE_MODES, Sanitizer, SanitizerError  # noqa: F401
 from .traceguard import RetraceError, TraceGuard  # noqa: F401

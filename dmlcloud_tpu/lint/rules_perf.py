@@ -1,0 +1,295 @@
+"""The donation/remat performance-contract rules (DML205-DML206).
+
+PR 6's kernel pass made the hot paths fast; these rules make the two
+memory contracts that keep them fast checkable on CPU:
+
+- DML205  a jitted train/decode step that RETURNS an updated version of a
+          TrainState / optimizer-state / KV-cache argument without
+          donating it — the old buffer stays live across the call, so the
+          biggest tensors in the program are held twice
+- DML206  ``lax.scan``/``nn.scan`` over a layer stack without a remat
+          policy — every layer's activations are saved for the backward,
+          so activation memory grows with depth instead of staying O(1)
+
+Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
+the state argument provably FLOWS TO THE RETURN (a read-only cache in a
+scoring function must not be donated — firing there would be a
+correctness bug, not a style nit), and the wrapped function is resolved
+through decorators, ``jax.jit(fn, ...)`` calls and ``functools.partial``
+forms. DML103 keeps its syntactic "train step with no donation at all"
+ground; DML205 covers what it cannot: donation present but MISSING an
+argument, and decode steps (cache-carrying functions DML103's name
+heuristic never sees). Sites DML103 already reports are skipped so one
+mistake yields one finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import dataflow
+from .engine import (
+    Finding,
+    ModuleCtx,
+    _compute_taint,
+    _donated_argnums,
+    _static_params,
+    attr_chain,
+    rule,
+)
+from .rules import _is_trainish
+
+__all__ = ["check_step_donation", "check_scan_remat"]
+
+
+def _f(ctx: ModuleCtx, rule_id: str, node: ast.AST, message: str, context: str = "") -> Finding:
+    return Finding(rule_id, ctx.path, node.lineno, node.col_offset, message, context)
+
+
+def _stateful_param(name: str) -> bool:
+    """Parameter names that carry the double-buffer hazard: train/optimizer
+    state and KV caches. ``params`` is deliberately NOT here — donating the
+    params of an eval/decode function that merely reads them would be a
+    correctness bug, and train-state donation is DML103's ground."""
+    n = name.lower()
+    return n in ("state", "opt", "optimizer", "kv") or n.endswith("state") or n.endswith("cache")
+
+
+def _param_names(fn) -> list[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _own_returns(fn):
+    """Return statements of ``fn``'s own scope (nested defs excluded)."""
+    for node in dataflow._body_walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            yield node
+
+
+#: receiver methods whose result IS a new version of the receiver
+_UPDATEISH = frozenset({"apply_gradients", "replace", "update", "updated", "set"})
+
+#: a returned binding named like state/cache counts as the updated buffer
+_STATEFUL_STEM = re.compile(r"(?i)(state|cache|opt\b|opt_|_opt|kv)")
+
+
+def _returns_updated(fn, pname: str, tainted: set[str]) -> bool:
+    """Whether ``fn`` returns something that IS a new version of parameter
+    ``pname`` — the param itself, an update-method call on it
+    (``state.apply_gradients(...)``), arithmetic on the bare param
+    (``state - grads``), or a tainted binding named like the state kind
+    (``new_cache``). Values merely DERIVED from the state (a loss, logits)
+    do not count: donating their source would be a correctness bug."""
+
+    def element_hits(e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id == pname or (e.id in tainted and bool(_STATEFUL_STEM.search(e.id)))
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute):
+            chain = attr_chain(e.func)
+            if chain and chain[0] == pname and e.func.attr in _UPDATEISH:
+                return True
+        if isinstance(e, ast.BinOp):
+            return any(
+                isinstance(side, ast.Name) and side.id == pname for side in (e.left, e.right)
+            )
+        return False
+
+    for r in _own_returns(fn):
+        elts = r.value.elts if isinstance(r.value, ast.Tuple) else [r.value]
+        if any(element_hits(e) for e in elts):
+            return True
+    return False
+
+
+def _donated_argnames(jit_kwargs: dict) -> set[str]:
+    names: set[str] = set()
+    kw = jit_kwargs.get("donate_argnames")
+    if kw is not None:
+        for c in ast.walk(kw):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                names.add(c.value)
+    return names
+
+
+# ------------------------------------------------------------------- DML205
+
+
+@rule("DML205", "jitted step does not donate its state/cache argument")
+def check_step_donation(ctx: ModuleCtx):
+    """A jitted step that consumes a TrainState/optimizer-state/KV-cache
+    argument and returns an updated version of it, without donating the
+    argument, keeps BOTH versions live across the call — for a train step
+    that is params+optimizer state twice, for a decode step the whole KV
+    cache twice. Flow-aware: fires only when the stateful argument
+    provably reaches a return value (read-only consumers stay silent —
+    donating those would be a bug), and only for arguments the site's
+    ``donate_argnums``/``donate_argnames`` misses."""
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    seen: set[tuple[int, int, str]] = set()
+    for site in ctx.jit_sites:
+        if site.target_name is None:
+            continue
+        if _is_trainish(site.target_name) and not (
+            "donate_argnums" in site.kwargs or "donate_argnames" in site.kwargs
+        ):
+            continue  # DML103's finding; one mistake, one report
+        defs = defs_by_name.get(site.target_name, [])
+        if len(defs) != 1:
+            continue  # ambiguous or unresolvable: silence, never a guess
+        fn = defs[0]
+        params = _param_names(fn)
+        statics = _static_params(fn, site.kwargs)
+        donated_idx = _donated_argnums(site.kwargs)
+        donated_names = _donated_argnames(site.kwargs)
+        for idx, pname in enumerate(params):
+            if pname in ("self", "cls") or not _stateful_param(pname):
+                continue
+            if pname in statics or idx in donated_idx or pname in donated_names:
+                continue
+            # flow check: is a NEW version of the state actually returned?
+            tainted = _compute_taint(fn, {pname})
+            if not _returns_updated(fn, pname, tainted):
+                continue  # read-only consumer: donation would be WRONG
+            key = (site.lineno, site.col, pname)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _f(
+                ctx, "DML205", site.node,
+                f"jitted step '{site.target_name}' returns an updated '{pname}' "
+                f"but does not donate it (add {idx} to donate_argnums): the old "
+                "buffer stays live across the call, holding the "
+                + ("KV cache" if pname.lower().endswith("cache") or pname.lower() == "kv"
+                   else "train/optimizer state")
+                + " twice",
+                site.target_name,
+            )
+
+
+# ------------------------------------------------------------------- DML206
+
+#: callee name (terminal segment) that identifies a transformer layer/block
+_LAYERISH = re.compile(r"(?i)(block|layer)s?(_?\d+)?$")
+_REMAT_NAMES = ("checkpoint", "remat")
+
+
+def _is_remat_call(ctx: ModuleCtx, node: ast.AST) -> bool:
+    """``jax.checkpoint(f)`` / ``jax.remat(f)`` / ``nn.remat(Block)`` /
+    ``functools.partial(jax.checkpoint, ...)`` call expressions."""
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func) or ""
+    last = resolved.split(".")[-1] if resolved else ""
+    if not last and isinstance(node.func, ast.Attribute):
+        last = node.func.attr
+    if last in _REMAT_NAMES:
+        return True
+    if last == "partial" and node.args:
+        return _is_remat_call(ctx, ast.Call(func=node.args[0], args=[], keywords=[])) or (
+            (ctx.resolve(node.args[0]) or "").split(".")[-1] in _REMAT_NAMES
+        )
+    return False
+
+
+def _has_remat_decorator(ctx: ModuleCtx, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        resolved = ctx.resolve(dec) or ""
+        if resolved.split(".")[-1] in _REMAT_NAMES:
+            return True
+        if isinstance(dec, ast.Call) and _is_remat_call(ctx, dec):
+            return True
+    return False
+
+
+def _bare_layer_call(ctx: ModuleCtx, body: ast.AST, scopes) -> ast.Call | None:
+    """First call inside ``body`` whose callee names a layer/block and is
+    not (provably) remat-wrapped — the hazard DML206 reports."""
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        seg = None
+        if isinstance(func, ast.Attribute):
+            seg = func.attr
+        elif isinstance(func, ast.Name):
+            seg = func.id
+            # a name bound to nn.remat(Block)/jax.checkpoint(f) is wrapped
+            bound = dataflow.resolve_expr(func, scopes)
+            if _is_remat_call(ctx, bound):
+                continue
+        if seg and _LAYERISH.search(seg):
+            return node
+    return None
+
+
+@rule("DML206", "scan over a layer stack without a remat policy")
+def check_scan_remat(ctx: ModuleCtx):
+    """``lax.scan`` over a stack of transformer layers saves EVERY layer's
+    activations for the backward pass — the per-layer memory times depth,
+    exactly what rematerialisation exists to cap. Fires when a scan body
+    (resolved through assignments, lambdas and local defs) calls something
+    layer/block-named with no ``jax.checkpoint``/``jax.remat``/``nn.remat``
+    anywhere on the path. Non-layer scans (decode steps, loss chunking,
+    ring hops) never match; an already-checkpointed body, a remat
+    decorator, or a ``nn.remat``-wrapped class all count as the policy
+    being present."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func) or ""
+        if resolved not in ("jax.lax.scan", "flax.linen.scan") and not (
+            resolved.endswith(".scan") and resolved.startswith(("jax.lax", "flax.linen"))
+        ):
+            continue
+        if not node.args:
+            continue
+        body_arg = node.args[0]
+        if _is_remat_call(ctx, body_arg):
+            continue  # scan(jax.checkpoint(body), ...)
+        scopes = ctx.scopes_at(node)
+        resolved_body = dataflow.resolve_expr(body_arg, scopes)
+        fn_name = getattr(ctx.enclosing_function(node), "name", "")
+
+        body = None
+        if isinstance(resolved_body, ast.Lambda):
+            body = resolved_body
+        elif isinstance(resolved_body, ast.Call) and _is_remat_call(ctx, resolved_body):
+            continue  # body = jax.checkpoint(f); scan(body, ...)
+        elif isinstance(resolved_body, ast.Name):
+            defs = [
+                d for d in ast.walk(ctx.tree)
+                if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and d.name == resolved_body.id
+            ]
+            if len(defs) == 1:
+                body = defs[0]
+                if _has_remat_decorator(ctx, body):
+                    continue
+            elif _LAYERISH.search(resolved_body.id):
+                # nn.scan(DecoderBlock, ...): the scanned TARGET is the layer
+                yield _f(
+                    ctx, "DML206", node,
+                    f"scan over layer class '{resolved_body.id}' without a remat "
+                    "policy: every layer's activations are saved for the backward "
+                    "— wrap it in nn.remat (or jax.checkpoint the body) so "
+                    "activation memory stays O(1) layers",
+                    fn_name,
+                )
+                continue
+        if body is None:
+            continue
+        hit = _bare_layer_call(ctx, body, scopes)
+        if hit is not None:
+            yield _f(
+                ctx, "DML206", node,
+                "scan over a layer stack without a remat policy: every layer's "
+                "activations are saved for the backward — wrap the scan body in "
+                "jax.checkpoint (jax.remat) so activation memory stays O(1) layers",
+                fn_name,
+            )

@@ -46,6 +46,27 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int | None = No
     }
 
 
+def rewind_cache(cache, fill_len):
+    """Rewind a KV cache to ``fill_len`` valid positions: slots at
+    ``position >= fill_len`` are zeroed in ONE masked select over the tree —
+    the single rewind primitive speculative decoding needs to discard a
+    rejected draft tail (instead of k per-slot re-dispatches), and the only
+    way to make a cache that speculated past ``fill_len`` bit-identical to
+    one that never did. ``fill_len`` may be traced ([B] per-row or scalar);
+    the masked positions never influence attention (the causal/attend_len
+    masks already exclude them), so rewinding is semantically free — it
+    matters when caches are compared, checkpointed, or handed to a consumer
+    that trusts the whole buffer."""
+    fill_len = jnp.asarray(fill_len, jnp.int32)
+
+    def mask_leaf(x):  # x: [B, S, KH, D]
+        pos = jnp.arange(x.shape[1], dtype=jnp.int32)
+        keep = pos[None, :] < jnp.reshape(fill_len, (-1, 1))  # [B or 1, S]
+        return jnp.where(keep[:, :, None, None], x, jnp.zeros((), x.dtype))
+
+    return jax.tree_util.tree_map(mask_leaf, cache)
+
+
 def _chunked_scan(step, carry, first_step, n_total, attend_len_for_end):
     """Run ``step(carry, i, attend_len=...)`` over steps
     [first_step, first_step + n_total) as at most ``_DECODE_CHUNKS``
@@ -105,12 +126,18 @@ def _generate_compiled(
     pad_id: int,
 ):
     b, t = prompt.shape
-    # int8 weight-only params (models/quant.py) rehydrate INSIDE the
-    # compiled program: HBM keeps the int8 buffers, XLA fuses the dequant
-    # into each consumer. No-op for ordinary trees.
-    from .quant import dequant_tree
+    # int8 weight-only kernels (models/quant.py) stay quantized END TO END:
+    # the quant-aware dense layers feed them to the matmul with the
+    # per-channel scales applied to the fp32 accumulator — q * scale is
+    # never materialised. Only exotically-quantized non-kernel leaves
+    # rehydrate here. Off-TPU, the int8 -> fp32 GEMM-operand widen is
+    # hoisted out of the decode loop (once per call, not once per step —
+    # see widen_quant_tree); on TPU q stays int8 into the MXU.
+    from .quant import dequant_tree, widen_quant_tree
 
-    params = dequant_tree(params, model.cfg.dtype)
+    params = dequant_tree(params, model.cfg.dtype, keep=lambda p: p.endswith("kernel"))
+    if jax.default_backend() != "tpu":
+        params = widen_quant_tree(params)
     # cache in the model's compute dtype so fp32 configs stay exact
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
 
@@ -235,10 +262,13 @@ def _beam_search_compiled(
     v = model.cfg.vocab_size
     neg = jnp.float32(-1e30)
 
-    # int8 weight-only params rehydrate in-program (see _generate_compiled)
-    from .quant import dequant_tree
+    # int8 weight-only kernels stay quantized in-program, with the off-TPU
+    # operand widen hoisted out of the beam loop (see _generate_compiled)
+    from .quant import dequant_tree, widen_quant_tree
 
-    params = dequant_tree(params, model.cfg.dtype)
+    params = dequant_tree(params, model.cfg.dtype, keep=lambda p: p.endswith("kernel"))
+    if jax.default_backend() != "tpu":
+        params = widen_quant_tree(params)
     # Prefill once per batch row, then tile the cache across beams.
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
     logits, cache = model.apply(

@@ -65,11 +65,11 @@ def default_match(path: str, leaf: Any) -> bool:
     return path.endswith("kernel") and getattr(leaf, "ndim", 0) >= 2
 
 
-def _paths(tree: Any) -> Any:
+def _paths(tree: Any, is_leaf=None) -> Any:
     """Tree of '/'-joined key paths, same structure as ``tree``."""
     from ..parallel.mesh import path_str
 
-    return jax.tree_util.tree_map_with_path(lambda kp, _: path_str(kp), tree)
+    return jax.tree_util.tree_map_with_path(lambda kp, _: path_str(kp), tree, is_leaf=is_leaf)
 
 
 def _as_matcher(match: Any) -> Callable[[str, Any], bool]:

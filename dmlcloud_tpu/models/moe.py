@@ -82,7 +82,9 @@ class MoEMLP(nn.Module):
         tokens = x.reshape(n_tok, d)
 
         # -- routing (fp32 for a stable softmax) ----------------------------
-        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="router")(
+        from .quant import QuantDense
+
+        logits = QuantDense(e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="router")(
             tokens.astype(jnp.float32)
         )  # [N, E]
         probs = jax.nn.softmax(logits, axis=-1)

@@ -11,9 +11,15 @@ Design:
 - ``QuantizedTensor`` is a pytree node carrying ``q`` (int8) + ``scale``
   (fp32, per-output-channel). It flows through jit like any array leaf,
   so quantized param trees drop into the existing ``generate`` /
-  ``beam_search`` entry points unchanged — they dequantize INSIDE the
-  compiled program, which keeps the HBM-resident buffers int8 and lets
-  XLA fuse the dequant (convert + multiply) into each consumer.
+  ``beam_search`` entry points unchanged.
+- The dequant is FUSED into each consuming matmul (:class:`QuantDense` /
+  :class:`QuantDenseGeneral`, :func:`_fused_quant_dot`): the int8 tensor
+  feeds ``lax.dot_general`` directly and the per-channel scales multiply
+  the fp32 accumulator — no dequantized weight copy is ever materialised,
+  so the weight stream stays 1 byte/element end to end. (The pre-PR-6
+  design dequantized the whole tree at program entry; XLA hoisted the
+  copies and the bandwidth saving never showed up — 1.02x in the r05
+  receipts, vs >= 1.2x fused.)
 - Symmetric per-channel quantization: ``w ~= q * scale`` with the amax
   reduced over the kernel's leading input axes, so every trailing output
   coordinate keeps its own scale (see :func:`quantize`).
@@ -26,11 +32,22 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from flax import struct
 
-__all__ = ["QuantizedTensor", "quantize", "quantize_tree", "dequant_tree", "quantized_size"]
+__all__ = [
+    "QuantizedTensor",
+    "QuantDense",
+    "QuantDenseGeneral",
+    "quantize",
+    "quantize_tree",
+    "dequant_tree",
+    "widen_quant_tree",
+    "prepare_decode_params",
+    "quantized_size",
+]
 
 
 class QuantizedTensor(struct.PyTreeNode):
@@ -52,6 +69,149 @@ class QuantizedTensor(struct.PyTreeNode):
         # compute dtype happens last. Under jit this is one fused
         # elementwise chain feeding the consumer matmul.
         return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def _fused_quant_dot(x: jax.Array, qt: QuantizedTensor, dtype) -> jax.Array:
+    """``x @ dequant(qt)`` WITHOUT a materialised dequantized weight copy:
+    the int8 tensor feeds ``lax.dot_general`` directly (the int8->compute
+    convert fuses into the matmul's operand read, so HBM streams 1 byte per
+    weight instead of 2-4) and the per-output-channel scales multiply the
+    fp32 ACCUMULATOR — O(out) work on the result instead of O(in*out) on
+    the weight. int8 values are exact in bf16 (8 mantissa bits cover ±127),
+    so this equals ``x @ (q * scale)`` up to the usual accumulation order.
+
+    Contracts ``x``'s last axis with ``q``'s first (the nn.Dense /
+    nn.DenseGeneral(axis=-1) convention); requires the quantization's
+    reduced axis to be that same first axis (``scale.shape[0] == 1``)."""
+    q = qt.q
+    # Operand precision is a per-backend choice (static at trace time):
+    # int8 is EXACT in both bf16 (8 mantissa bits cover ±127) and fp32, so
+    # either is a faithful dequant. On TPU the operands stay in the compute
+    # dtype — the narrow-operand MXU path is the fast one. Everywhere else
+    # they promote to the fp32 accumulator's precision: XLA:CPU emulates
+    # bf16 GEMMs (widen + fp32 GEMM + round EVERY step), so the quantized
+    # decode runs the native fp32 GEMM directly. The widen itself is hoisted
+    # out of the decode loop by :func:`widen_quant_tree` (q arrives here
+    # already fp32 and the astype below is a no-op); the bf16 baseline
+    # cannot hoist its emulation widen, and skipping that per-step tax is
+    # where the measured CPU decode win comes from.
+    if not jnp.issubdtype(q.dtype, jnp.integer):
+        op_dtype = q.dtype  # pre-widened by widen_quant_tree — use as-is
+    else:
+        op_dtype = dtype if jax.default_backend() == "tpu" else jnp.promote_types(jnp.float32, dtype)
+    acc = jax.lax.dot_general(
+        x.astype(op_dtype),
+        q.astype(op_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [..., *out] fp32
+    scale = qt.scale.reshape(q.shape[1:])  # drop the keepdims reduced axis
+    return (acc * scale).astype(dtype)
+
+
+def _fusible(qt: QuantizedTensor) -> bool:
+    """Whether the fused path applies: per-output-channel scales reduced
+    over exactly the first (contracted) axis."""
+    import math
+
+    return qt.scale.shape[0] == 1 and qt.scale.size == math.prod(qt.q.shape[1:])
+
+
+def widen_quant_tree(params: Any, dtype=jnp.float32) -> Any:
+    """Hoist the int8 -> GEMM-operand widen OUT of a decode loop (CPU/GPU
+    only; a no-op tree on TPU callers' side — don't call it there).
+
+    On backends whose GEMMs cannot consume int8 operands, every
+    ``_fused_quant_dot`` call widens ``q`` to fp32 — and when that call
+    sits inside a ``scan``/``while_loop`` decode body, XLA:CPU re-runs the
+    widen (write + read of a 4-byte copy) EVERY step, exactly the
+    emulation tax the bf16 baseline pays. Calling this once before the
+    loop (inside jit) converts each fusible kernel's ``q`` a single time;
+    the ``optimization_barrier`` pins the widened buffers so XLA cannot
+    sink the converts back into the loop body. Scales stay separate and
+    still multiply the accumulator in :func:`_fused_quant_dot` —
+    ``q * scale`` is never materialised, and the arithmetic is bit-for-bit
+    the per-step path (int8 -> fp32 is exact)."""
+    is_qt = lambda x: isinstance(x, QuantizedTensor)
+    widened = jax.tree_util.tree_map(
+        lambda x: x.replace(q=x.q.astype(dtype)) if is_qt(x) and _fusible(x) else x,
+        params,
+        is_leaf=is_qt,
+    )
+    return jax.lax.optimization_barrier(widened)
+
+
+def prepare_decode_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    """ONE-TIME host-side preparation of a (possibly int8-quantized) tree
+    for repeated decode calls: non-kernel quantized leaves rehydrate to
+    ``dtype`` and, off-TPU, fusible int8 kernels pre-widen to the GEMM
+    operand dtype so no per-call widen remains inside the compiled decode
+    program (the in-program :func:`widen_quant_tree` then no-ops). On TPU
+    kernels stay int8 — the MXU consumes them directly and pre-widening
+    would only inflate HBM. Serving loops that decode from the same
+    weights many times should call this once at model-load time; passing
+    the raw quantized tree to :func:`~dmlcloud_tpu.models.generate.generate`
+    stays correct and merely re-pays the widen each call."""
+    params = dequant_tree(params, dtype, keep=lambda p: p.endswith("kernel"))
+    if jax.default_backend() == "tpu":
+        return params
+    is_qt = lambda x: isinstance(x, QuantizedTensor)
+    return jax.tree_util.tree_map(
+        lambda x: x.replace(q=x.q.astype(jnp.float32)) if is_qt(x) and _fusible(x) else x,
+        params,
+        is_leaf=is_qt,
+    )
+
+
+class QuantDense(nn.Dense):
+    """``nn.Dense`` that natively consumes an int8 :class:`QuantizedTensor`
+    kernel via :func:`_fused_quant_dot` — decode-path layers use this so
+    quantized param trees run without any dequantized weight copy. With an
+    ordinary array kernel (including at init) it IS ``nn.Dense``."""
+
+    @nn.compact
+    def __call__(self, inputs):
+        kernel = (
+            self.get_variable("params", "kernel") if self.has_variable("params", "kernel") else None
+        )
+        if not isinstance(kernel, QuantizedTensor):
+            return super().__call__(inputs)
+        if not _fusible(kernel):  # exotic scale layout: correctness over speed
+            y = inputs.astype(self.dtype) @ kernel.dequant(self.dtype)
+        else:
+            y = _fused_quant_dot(inputs, kernel, self.dtype)
+        if self.use_bias:
+            y = y + self.get_variable("params", "bias").astype(self.dtype)
+        return y
+
+
+class QuantDenseGeneral(nn.DenseGeneral):
+    """``nn.DenseGeneral`` twin of :class:`QuantDense` (supports the
+    ``axis=-1`` single-contraction form the transformer uses; other axis
+    configurations fall back to a dequantized matmul)."""
+
+    @nn.compact
+    def __call__(self, inputs):
+        kernel = (
+            self.get_variable("params", "kernel") if self.has_variable("params", "kernel") else None
+        )
+        if not isinstance(kernel, QuantizedTensor) or self.axis != -1 or self.batch_dims:
+            if isinstance(kernel, QuantizedTensor):  # unsupported layout: dequantize locally
+                kernel = kernel.dequant(self.dtype)
+                contract = (((inputs.ndim - 1,), (0,)), ((), ()))
+                return jax.lax.dot_general(inputs.astype(self.dtype), kernel, contract)
+            return super().__call__(inputs)
+        if not _fusible(kernel):
+            y = jax.lax.dot_general(
+                inputs.astype(self.dtype),
+                kernel.dequant(self.dtype),
+                (((inputs.ndim - 1,), (0,)), ((), ())),
+            )
+        else:
+            y = _fused_quant_dot(inputs, kernel, self.dtype)
+        if self.use_bias:
+            y = y + self.get_variable("params", "bias").astype(self.dtype)
+        return y
 
 
 def quantize(w: jax.Array, *, num_input_axes: int = 1) -> QuantizedTensor:
@@ -87,14 +247,29 @@ def quantize_tree(params: Any, match: Callable[[str, Any], bool] | None = None) 
     )
 
 
-def dequant_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+def dequant_tree(params: Any, dtype=jnp.bfloat16, keep: Callable[[str], bool] | None = None) -> Any:
     """Rehydrate a (possibly partially) quantized tree to ``dtype`` arrays.
     Pure and cheap to call inside jit — a no-op tree_map when nothing is
-    quantized."""
+    quantized.
+
+    ``keep`` (path -> bool) leaves matching quantized leaves AS
+    QuantizedTensor: the decode paths pass ``keep=lambda p:
+    p.endswith("kernel")`` so matmul kernels stay int8 for the fused
+    :class:`QuantDense` layers (no materialised weight copy) while any
+    exotically-quantized leaf a custom matcher produced (an embedding, a
+    bias) still rehydrates for its quant-unaware consumer."""
+    is_qt = lambda x: isinstance(x, QuantizedTensor)
+    if keep is None:
+        return jax.tree_util.tree_map(
+            lambda x: x.dequant(dtype) if is_qt(x) else x, params, is_leaf=is_qt
+        )
+    from .lora import _paths
+
     return jax.tree_util.tree_map(
-        lambda x: x.dequant(dtype) if isinstance(x, QuantizedTensor) else x,
+        lambda path, x: x.dequant(dtype) if is_qt(x) and not keep(path) else x,
+        _paths(params, is_leaf=is_qt),
         params,
-        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+        is_leaf=is_qt,
     )
 
 

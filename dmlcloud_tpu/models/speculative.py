@@ -22,20 +22,33 @@ path at all; this composes with the int8 weight-only quantization in
 
 TPU-first mechanics (everything static-shape, one compiled program):
 
-- One ``lax.while_loop`` over verification rounds; each round does k+1
-  single-token draft passes (a ``lax.scan``) and one (k+1)-token target
-  pass at a DYNAMIC cache offset (the transformer's decode path already
-  supports traced offsets).
+- One ``lax.while_loop`` over verification rounds, with the whole
+  accept/rollback decision ON DEVICE — no host round-trips anywhere in
+  the loop. Each round runs exactly ``k`` draft passes (unrolled — ``k``
+  is static) and one (k+1)-token target pass at a DYNAMIC cache offset
+  (the transformer's decode path already supports traced offsets).
+- The FIRST draft pass of a round processes two tokens
+  ``[y[pos-2], y[pos-1]]`` at offset ``pos-2``: when the previous round
+  accepted all ``k`` proposals, the draft cache has a one-slot gap at the
+  bonus token's position — the 2-token pass fills it, which is what lets
+  the round run ``k`` draft passes instead of the k+1 the pre-PR-6 loop
+  paid (the old (k+1)-th pass existed only to write that slot every
+  round). In every other case the extra slot is an identical rewrite.
 - Rejected proposals leave stale K/V in both caches, but every round
   writes the contiguous range starting at its own offset, and the next
   round's offset never exceeds the previous offset + accepted + 1 — so
-  stale slots are always overwritten before the causal mask can expose
-  them (round r+1 writes [o', o'+k+1) which covers the stale tail of
-  round r's [o, o+k+1) because o' >= o+1).
+  stale slots are always overwritten (in-pass, before attention reads
+  them) before the causal mask can expose them. ``return_cache=True``
+  additionally applies :func:`~dmlcloud_tpu.models.generate.rewind_cache`
+  ONCE after the loop — one masked select discarding the whole stale
+  tail, instead of per-slot re-dispatches — so the returned caches are
+  bit-identical to a non-speculative decode of the same accepted prefix.
 - Batching: the B=1 routine is ``vmap``-ed over rows (per-row dynamic
   offsets come for free); under vmap the while_loop keeps running until
-  every row finishes, so all carry updates are masked by a per-row
-  ``done`` flag.
+  every row finishes. Only the CHEAP carry leaves (pos/y/done/counters)
+  are done-masked: a finished row's cache writes keep landing at its
+  frozen ``pos`` with frozen inputs — idempotent, never read back into
+  ``y`` — so the loop avoids two whole-cache selects per round.
 """
 
 from __future__ import annotations
@@ -70,13 +83,21 @@ def _row_spec_decode(
     temperature,  # traced scalar — a new value must not recompile
     sampled: bool,  # static: selects the greedy or rejection-sampling body
     ragged: bool,  # static: False keeps the pad_len=None fast path compiled
-    return_stats: bool = False,  # static: also return (rounds, generated)
+    return_stats: bool = False,  # static: also return (rounds, advanced, accepted)
+    return_cache: bool = False,  # static: also return the rewound KV caches
 ):
-    from .generate import init_cache
-    from .quant import dequant_tree
+    from .generate import init_cache, rewind_cache
+    from .quant import dequant_tree, widen_quant_tree
 
-    target_params = dequant_tree(target_params, target.cfg.dtype)
-    draft_params = dequant_tree(draft_params, draft.cfg.dtype)
+    # int8 kernels stay quantized for the fused QuantDense path; only
+    # exotic non-kernel quantized leaves rehydrate, and off-TPU the operand
+    # widen is hoisted out of the verification loop (see generate.py)
+    keep_kernel = lambda p: p.endswith("kernel")
+    target_params = dequant_tree(target_params, target.cfg.dtype, keep=keep_kernel)
+    draft_params = dequant_tree(draft_params, draft.cfg.dtype, keep=keep_kernel)
+    if jax.default_backend() != "tpu":
+        target_params = widen_quant_tree(target_params)
+        draft_params = widen_quant_tree(draft_params)
 
     t = prompt.shape[0]
     # vmap hands a scalar; apply wants [B]=[1]. Unpadded calls pass None so
@@ -121,9 +142,11 @@ def _row_spec_decode(
         "tcache": tcache,
         "dcache": dcache,
         "done": first_tok == eos_id,
-        # verification rounds run (one target pass each) — the accept-rate
-        # observable: generated = 1 + sum(n_accept_r + 1) over rounds
+        # verification rounds run (one target pass each) and draft
+        # proposals the verifier accepted — together the EXACT accept-rate
+        # observable: accept_rate = accepted / (rounds * k)
         "rounds": jnp.asarray(0, jnp.int32),
+        "accepted": jnp.asarray(0, jnp.int32),
     }
 
     def cond(s):
@@ -131,34 +154,41 @@ def _row_spec_decode(
 
     def round_body(s):
         pos = s["pos"]
+        y = s["y"]
         round_key = jax.random.fold_in(s["rng"], pos) if sampled else None
 
-        # --- draft proposes k tokens (k+1 passes: the last one exists only
-        # to write d_k's K/V so the draft cache has no gap after a full
-        # acceptance) ---
-        def draft_step(carry, i):
-            dcache, prev = carry
-            logits, dcache = draft.apply(
-                {"params": draft_params},
-                prev[None, None],
-                cache=dcache,
-                offset=pos - 1 + i,
-                pad_len=pad_len,
-                attend_len=cache_len,
-            )
-            row = logits[0, 0]
+        def pick_draft(row, i):
             if sampled:
-                nxt = jax.random.categorical(
+                return jax.random.categorical(
                     jax.random.fold_in(round_key, i), row.astype(jnp.float32) / temperature
                 ).astype(jnp.int32)
-            else:
-                nxt = _greedy(row)
-            return (dcache, nxt), (nxt, row)
+            return _greedy(row)
 
-        (dcache, _), (proposals, dlogits) = jax.lax.scan(
-            draft_step, (s["dcache"], s["y"][pos - 1]), jnp.arange(k + 1)
+        # --- draft proposes k tokens in k passes (unrolled: k is static).
+        # Pass 0 feeds [y[pos-2], y[pos-1]] at offset pos-2 — the extra
+        # leading token closes the draft cache's one-slot gap after a
+        # fully-accepted round (see module docstring) and is an identical
+        # rewrite otherwise; its last-position logits propose d_1.
+        first2 = jax.lax.dynamic_slice(y, (pos - 2,), (2,))[None]  # [1, 2]
+        logits, dcache = draft.apply(
+            {"params": draft_params}, first2, cache=s["dcache"],
+            offset=pos - 2, pad_len=pad_len, attend_len=cache_len,
         )
-        proposals = proposals[:k]  # [k] — the (k+1)-th output is discarded
+        nxt = pick_draft(logits[0, -1], 0)
+        props, drows = [nxt], [logits[0, -1]]
+        for i in range(1, k):  # k-1 single-token passes
+            logits, dcache = draft.apply(
+                {"params": draft_params}, nxt[None, None], cache=dcache,
+                offset=pos - 1 + i, pad_len=pad_len, attend_len=cache_len,
+            )
+            nxt = pick_draft(logits[0, 0], i)
+            props.append(nxt)
+            drows.append(logits[0, 0])
+        proposals = jnp.stack(props)  # [k]
+        # row i is the draft distribution d_{i+1} was sampled from; the
+        # rejection-sampling residual needs a (k+1)-th row only as an
+        # indexing placeholder (never selected — see below)
+        dlogits = jnp.concatenate([jnp.stack(drows), jnp.zeros((1,) + drows[0].shape, drows[0].dtype)])
 
         # --- target verifies y[pos-1], d_1..d_k in one pass ---
         x = jnp.concatenate([s["y"][pos - 1][None], proposals])[None]  # [1, k+1]
@@ -197,7 +227,7 @@ def _row_spec_decode(
             n_accept = jnp.argmin(jnp.concatenate([accept, jnp.asarray([False])]))
             # the position-n_accept token: residual resample on rejection,
             # plain target sample when every proposal was accepted (the
-            # dlp row there is the discarded (k+1)-th draft pass — unused)
+            # dlp row there is the zero placeholder — never selected)
             p_t = jnp.exp(tlp[n_accept])
             residual = jnp.maximum(p_t - jnp.exp(dlp[n_accept]), 0.0)
             probs = jnp.where(n_accept == k, p_t, residual)
@@ -221,16 +251,20 @@ def _row_spec_decode(
             jnp.where(hit_eos, jnp.argmax(is_eos & ~seen_eos) + 1, k + 1),
         ).astype(jnp.int32)
 
-        y_new = jax.lax.dynamic_update_slice(s["y"], new_tokens, (pos,))
+        y_new = jax.lax.dynamic_update_slice(y, new_tokens, (pos,))
         done_row = s["done"]
+        # caches are deliberately NOT done-masked (two whole-tree selects
+        # per round): a done row's pos/y freeze below, so its repeated
+        # writes are idempotent and never reach the output
         new_state = {
             "pos": jnp.where(done_row, pos, pos + n_new),
-            "y": jnp.where(done_row, s["y"], y_new),
+            "y": jnp.where(done_row, y, y_new),
             "rng": s["rng"],
-            "tcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["tcache"], tcache),
-            "dcache": jax.tree_util.tree_map(lambda old, new: jnp.where(done_row, old, new), s["dcache"], dcache),
+            "tcache": tcache,
+            "dcache": dcache,
             "done": done_row | hit_eos,
             "rounds": jnp.where(done_row, s["rounds"], s["rounds"] + 1),
+            "accepted": jnp.where(done_row, s["accepted"], s["accepted"] + n_accept),
         }
         return new_state
 
@@ -239,12 +273,32 @@ def _row_spec_decode(
     # positions past the fill (loop exited with pos < t+max_new on eos)
     fill = state["pos"] - t
     out = jnp.where(jnp.arange(max_new_tokens) < fill, out, pad_id)
+    extras = []
     if return_stats:
-        # UNCLAMPED advance: the final round may overshoot max_new_tokens by
-        # up to k (the surplus is masked out of `out` above). Returning the
-        # true advance keeps the accept-rate algebra exact:
-        # advanced - 1 == sum over rounds of (n_accept_r + 1).
-        return out, (state["rounds"], fill)
+        # `fill` is the UNCLAMPED advance: the final round may overshoot
+        # max_new_tokens by up to k (the surplus is masked out of `out`
+        # above). `accepted` is the exact verifier acceptance count, so
+        # accept_rate = accepted / (rounds * k) holds even under eos
+        # truncation (where the advance-based algebra breaks).
+        extras.append((state["rounds"], fill, state["accepted"]))
+    if return_cache:
+        # ONE rewind primitive discards both caches' stale speculative
+        # tails. Rewind to pos - 1, NOT pos: slot pos-1 is the one slot the
+        # loop's overwrite invariant does not reach — after a rejection it
+        # holds the REJECTED draft's K/V (the correction token was emitted
+        # but its slot is only rewritten by the next round's pass), and
+        # after a fully-accepted round the bonus token's slot was never
+        # written at all. The decode convention self-heals (the pass that
+        # consumes y[p] writes slot p before attending), so zeroing it is
+        # free for consumers and makes every KEPT slot provably correct.
+        extras.append(
+            (
+                rewind_cache(state["tcache"], state["pos"] - 1),
+                rewind_cache(state["dcache"], state["pos"] - 1),
+            )
+        )
+    if extras:
+        return (out, *extras)
     return out
 
 
@@ -252,17 +306,18 @@ def _row_spec_decode(
     jax.jit,
     static_argnames=(
         "target", "draft", "max_new_tokens", "k", "eos_id", "pad_id", "sampled", "ragged",
-        "return_stats",
+        "return_stats", "return_cache",
     ),
 )
 def _spec_compiled(
     target, draft, target_params, draft_params, prompt, rng, pad_len, temperature,
-    max_new_tokens, k, eos_id, pad_id, sampled, ragged, return_stats=False,
+    max_new_tokens, k, eos_id, pad_id, sampled, ragged, return_stats=False, return_cache=False,
 ):
     row_fn = functools.partial(
         _row_spec_decode, target, draft,
         max_new_tokens=max_new_tokens, k=k, eos_id=eos_id, pad_id=pad_id,
         temperature=temperature, sampled=sampled, ragged=ragged, return_stats=return_stats,
+        return_cache=return_cache,
     )
     row_keys = jax.random.split(rng, prompt.shape[0])
     return jax.vmap(
@@ -285,6 +340,7 @@ def speculative_generate(
     eos_id: int = -1,
     pad_id: int = 0,
     return_stats: bool = False,
+    return_cache: bool = False,
 ):
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, T] using
     ``draft`` to propose ``k`` tokens per target verification pass: at
@@ -302,14 +358,27 @@ def speculative_generate(
     first). The temperature value is traced (sweeping it does not
     recompile); only the greedy-vs-sampled switch is compiled in.
 
-    ``return_stats=True`` additionally returns ``(rounds, advanced)`` int32
-    arrays [B]: verification rounds run (= target decode passes) and
-    positions the decode loop advanced per row — ``advanced`` can exceed
-    ``max_new_tokens`` by up to ``k`` when the final round overshoots (the
-    surplus tokens are masked out of the returned sequence). Each round
-    accepts ``n_accept`` draft proposals plus one target token (and the
-    first token costs no round), so absent eos the per-row draft accept
-    rate is exactly ``(advanced - 1 - rounds) / (rounds * k)``."""
+    ``return_stats=True`` additionally returns ``(rounds, advanced,
+    accepted)`` int32 arrays [B]: verification rounds run (= target decode
+    passes), positions the decode loop advanced per row — ``advanced`` can
+    exceed ``max_new_tokens`` by up to ``k`` when the final round
+    overshoots (the surplus tokens are masked out of the returned
+    sequence) — and the EXACT count of verifier-accepted draft proposals,
+    so the per-row accept rate is ``accepted / (rounds * k)`` (exact even
+    when an in-round eos truncates the advance; absent eos it equals the
+    older ``(advanced - 1 - rounds) / (rounds * k)`` algebra).
+
+    ``return_cache=True`` additionally returns ``(target_cache,
+    draft_cache)`` with each row's cache REWOUND (one
+    ``generate.rewind_cache`` masked select, not k re-dispatches) to
+    ``advanced - 1`` valid positions: every kept slot is bit-identical to a
+    non-speculative decode of the same tokens, and the speculative tail —
+    including the final token's slot, which the loop's overwrite invariant
+    never certifies — is zeroed. (The decode convention writes slot ``p``
+    in the pass that consumes token ``p``, so a consumer resuming from the
+    final token re-fills the zeroed slot before anything reads it.) Leaves
+    are [B, S, KH, D], ``init_cache``'s layout (the vmap row axis replaces
+    the per-row singleton batch axis)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     _, t = prompt.shape
     if k < 1:
@@ -333,9 +402,16 @@ def speculative_generate(
     # greedy-vs-sampled is the only static switch; the temperature VALUE is
     # a traced operand so sweeping it never recompiles (generate()'s
     # convention). The 1e-6 clamp keeps the unused division safe at t == 0.
-    return _spec_compiled(
+    out = _spec_compiled(
         target, draft, target_params, draft_params, prompt, rng, pad_len,
         jnp.float32(max(float(temperature), 1e-6)),
         int(max_new_tokens), int(k), int(eos_id), int(pad_id), float(temperature) > 0.0, ragged,
-        return_stats=bool(return_stats),
+        return_stats=bool(return_stats), return_cache=bool(return_cache),
     )
+    if return_cache:
+        # vmap left each row's singleton batch axis inside: [B, 1, S, KH, D]
+        # -> init_cache's [B, S, KH, D]
+        *rest, caches = out
+        caches = jax.tree_util.tree_map(lambda x: x.squeeze(1), caches)
+        return (*rest, caches)
+    return out

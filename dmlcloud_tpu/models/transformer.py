@@ -212,8 +212,12 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None):
+        from .quant import QuantDenseGeneral
+
         cfg = self.cfg
-        dense = lambda feats, name: nn.DenseGeneral(
+        # quant-aware: int8 weight-only trees (models/quant.py) feed the
+        # matmuls directly, scales applied to the fp32 accumulator
+        dense = lambda feats, name: QuantDenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
         )
         b, t, _ = x.shape
@@ -301,7 +305,9 @@ class Attention(nn.Module):
             out = _dot_attention(q, k, v, causal=True)
 
         out = out.reshape(b, t, cfg.num_heads * cfg.head_dim)
-        proj = nn.DenseGeneral(
+        from .quant import QuantDenseGeneral
+
+        proj = QuantDenseGeneral(
             cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj"
         )(out)
         return proj if new_cache is None else (proj, new_cache)
@@ -312,8 +318,10 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from .quant import QuantDense
+
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(
+        dense = lambda feats, name: QuantDense(
             feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
         )
         gate = dense(cfg.mlp_dim, "gate_proj")(x)
@@ -445,7 +453,9 @@ class DecoderLM(nn.Module):
             embed = self.variables["params"]["embed"]["embedding"]
             logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embed.astype(jnp.float32))
         else:
-            logits = nn.Dense(
+            from .quant import QuantDense
+
+            logits = QuantDense(
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
             )(x)
         return logits if new_cache is None else (logits, new_cache)

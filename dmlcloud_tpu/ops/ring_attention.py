@@ -69,8 +69,8 @@ def ring_attention(
     axis_name: str = "seq",
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     window: int | None = None,
 ) -> jnp.ndarray:
@@ -143,6 +143,10 @@ def ring_attention(
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return (m, w, acc, kb, vb), None
 
+    # the scan is over ring HOPS, not layers: the carry is O(1) merge stats
+    # (m/w/acc) and the heavy per-block attention is the flash custom-vjp,
+    # which already recomputes instead of saving
+    # dmllint: disable-next-line=DML206 -- ring hops, remat would re-run the whole ring
     (m, w, acc, _, _), _ = jax.lax.scan(body, (m0, w0, acc0, k, v), jnp.arange(n))
     return (acc / w[..., None]).astype(q.dtype)
 
@@ -169,8 +173,13 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
     idx = jax.lax.axis_index(axis_name)
     if sm_scale is None:
         sm_scale = 1.0 / _math.sqrt(d)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    from .flash_attention import _XLA_BLOCK_Q, _default_mode
+
+    mode = _default_mode(interpret)
+    if block_q is None:
+        block_q = _XLA_BLOCK_Q if mode == "xla" else 512
+    if block_k is None:
+        block_k = 1024
     bq, bk = _auto_block(block_q, tl), _auto_block(block_k, tl)
 
     # hop `step` >= 1 participates iff its closest pair distance
@@ -187,7 +196,7 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
 
     for step in range(steps_needed):
         if step == 0:
-            out_b, lse_b = _flash_lse(q, kb, vb, None, True, float(sm_scale), bq, bk, bool(interpret), window)
+            out_b, lse_b = _flash_lse(q, kb, vb, None, True, float(sm_scale), bq, bk, mode, window)
             lse_b = to_bth(lse_b)
         else:
             # a device holds the block `step` behind it iff idx >= step;
@@ -195,7 +204,7 @@ def _ring_attention_windowed(q, k, v, axis_name, window, sm_scale, block_q, bloc
             w_eff = window - step * tl  # static relative cutoff in local coords
 
             def behind(q, kb, vb):
-                o, l = _flash_lse(q, kb, vb, None, False, float(sm_scale), bq, bk, bool(interpret), w_eff)
+                o, l = _flash_lse(q, kb, vb, None, False, float(sm_scale), bq, bk, mode, w_eff)
                 return o, to_bth(l)
 
             def ahead(q, kb, vb):
@@ -223,8 +232,8 @@ def ring_attention_sharded(
     axis_name: str = "seq",
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
     window: int | None = None,
 ) -> jnp.ndarray:

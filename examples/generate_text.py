@@ -96,18 +96,20 @@ def main():
         dcfg = dataclasses.replace(model.cfg, num_layers=1)
         draft = DecoderLM(dcfg)
         dparams = draft.init(jax.random.PRNGKey(args.seed + 1), jnp.zeros((1, 8), jnp.int32))["params"]
-        spec, (rounds, advanced) = speculative_generate(
+        spec, (rounds, advanced, accepted) = speculative_generate(
             model, params, draft, dparams, prompt, args.max_new, k=args.speculative,
             temperature=args.temperature, rng=jax.random.PRNGKey(args.seed),
             prompt_mask=jnp.asarray(mask), return_stats=True,
         )
         mode = "greedy" if args.temperature == 0 else f"sampled T={args.temperature}"
-        rounds, advanced = np.asarray(rounds, np.float64), np.asarray(advanced, np.float64)
+        rounds, accepted = np.asarray(rounds, np.float64), np.asarray(accepted, np.float64)
         for row, toks in enumerate(np.asarray(spec)):
             print(f"row {row} (speculative k={args.speculative}, {mode}): {toks.tolist()}")
-        # max_new=1 needs no verification round; there is no rate to report
+        # max_new=1 needs no verification round; there is no rate to report.
+        # `accepted` is the exact verifier counter — robust under eos, where
+        # the old (advanced - 1 - rounds) algebra breaks.
         rate = (
-            f"{np.mean((advanced - 1 - rounds) / (rounds * args.speculative)):.2f}"
+            f"{np.mean(accepted / (rounds * args.speculative)):.2f}"
             if rounds.min() > 0 else "n/a (no verification rounds)"
         )
         print(

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Kernel A/B receipt: the three hot-path kernels vs their baselines on the
+pinned CPU-smoke configs (doc/performance.md §"Kernel receipts"):
+
+- flash attention fwd AND fwd+bwd vs the unfused einsum reference
+  (blockwise-XLA lowering + custom_vjp recompute-from-LSE backward)
+- speculative decode vs plain greedy (on-device accept loop; includes the
+  shared-model smoke where draft == target must accept at exactly 1.0)
+- int8 weight-only decode (fused QuantDense, prepare_decode_params) vs bf16
+
+Thin CLI over ``bench.bench_kernels`` (which runs ``bench.py
+--kernels-child`` CPU-pinned) so the committed receipt and an interactive
+investigation run the exact same workload. The receipt's flat ``gate``
+section is what ``bench.py --gate`` / scripts/perf_gate.sh compares.
+
+    JAX_PLATFORMS=cpu python scripts/bench_kernels.py --out BENCH_kernels_pr06.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    args = parser.parse_args()
+
+    from bench import bench_kernels
+
+    results = bench_kernels()
+    if results is None:
+        print("kernel bench failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,8 @@
 # Actions; locally the same command prints ::error lines and exits 1.
 #
 # Usage: scripts/lint_gate.sh [extra lint args, e.g. --jobs 4]
+# CI runs this first, then the perf regression gate:
+#     scripts/lint_gate.sh && scripts/perf_gate.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m dmlcloud_tpu lint dmlcloud_tpu examples bench.py scripts --format=github "$@"

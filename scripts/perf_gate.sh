@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI perf gate: the current kernel ratios (flash fwd / fwd+bwd vs unfused,
+# speculative speedup + accept rate, int8 decode) and goodput fraction must
+# not drop more than the tolerance below the last committed
+# BENCH_kernels_*.json receipt (doc/performance.md §"Kernel receipts").
+# Runs after the lint gate in the CI flow:
+#
+#     scripts/lint_gate.sh && scripts/perf_gate.sh
+#
+# Usage: scripts/perf_gate.sh [extra gate args, e.g. --tolerance 0.2
+#        --baseline BENCH_kernels_pr06.json --current fresh.json]
+# With no --current the gate measures fresh ratios in a CPU-pinned child
+# (a few minutes); exit 0 pass, 1 regression, 2 could-not-measure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python bench.py --gate "$@"

@@ -10,14 +10,14 @@ from dmlcloud_tpu import TrainValStage
 
 
 @jax.jit
-def train_fn(state, batch, flag):
+def train_fn(acc, batch, flag):
     if batch.sum() > 0:  # BAD: branches on traced data
-        state = state + 1
+        acc = acc + 1
     while flag:  # BAD: loops on a traced value
         flag = flag - 1
     for row in batch:  # BAD: unrolls the trace over a traced value
-        state = state + row
-    return state
+        acc = acc + row
+    return acc
 
 
 class BranchyStage(TrainValStage):

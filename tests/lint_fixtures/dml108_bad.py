@@ -21,6 +21,6 @@ class TimerStage(TrainValStage):  # noqa: F821 — corpus, never executed
 
 
 @jax.jit
-def step(state, batch):
+def step(params, batch):
     started = time.time()  # BAD: wall clock inside a traced step
-    return state, {"t": started}
+    return params, {"t": started}

@@ -6,8 +6,8 @@ Static lint corpus — never imported or executed.
 import jax
 
 
-def update(state, batch):
-    return state
+def update(value, batch):
+    return value
 
 
 train = jax.jit(update, donate_argnums=0)

@@ -1,0 +1,32 @@
+"""DML205 bad corpus: jitted steps that return an updated state/cache
+argument without donating it. Expected findings: 3 (lines marked BAD)."""
+import functools
+
+import jax
+import optax
+
+
+def train_step(state, opt, batch):
+    grads = jax.grad(lambda p: p.sum())(state)
+    new_state = state - grads
+    updates, new_opt = optax.sgd(0.1).update(grads, opt)
+    return new_state, new_opt, updates
+
+
+# donation PRESENT but missing the optimizer state (index 1)
+step = jax.jit(train_step, donate_argnums=(0,))  # BAD: 'opt' not donated
+
+
+def decode_step(cache, tok):
+    new_cache = dict(cache)
+    new_cache["k"] = cache["k"] + tok
+    return tok * 2, new_cache
+
+
+# a decode step's KV cache is the big buffer — not donated at all
+decode = jax.jit(decode_step)  # BAD: 'cache' not donated
+
+
+@functools.partial(jax.jit, donate_argnums=(1,), static_argnames=("width",))
+def update_fn(opt_state, grads, width=4):  # BAD: 'opt_state' not donated
+    return opt_state + grads * width

@@ -1,0 +1,42 @@
+"""DML205 clean corpus: donation present where it matters, and read-only
+state consumers that must NOT be asked to donate."""
+import functools
+
+import jax
+import optax
+
+
+def train_step(state, opt, batch):
+    grads = jax.grad(lambda p: p.sum())(state)
+    updates, new_opt = optax.sgd(0.1).update(grads, opt)
+    return state - grads, new_opt
+
+
+# both stateful args donated (positional and by-name forms)
+step = jax.jit(train_step, donate_argnums=(0, 1))
+step2 = jax.jit(train_step, donate_argnums=(0,), donate_argnames=("opt",))
+
+
+def decode_step(cache, tok):
+    new_cache = dict(cache)
+    new_cache["k"] = cache["k"] + tok
+    return tok * 2, new_cache
+
+
+decode = jax.jit(decode_step, donate_argnums=(0,))
+
+
+# READ-ONLY cache: the return does not derive from it — donating it would
+# be a correctness bug, so the rule must stay silent
+def score_step(cache, tok):
+    del cache  # consulted upstream only
+    return tok * 2
+
+
+score = jax.jit(score_step)
+
+
+# static state-named arg is configuration, not a traced buffer
+@functools.partial(jax.jit, static_argnames=("opt_state",))
+def configured(opt_state, x):
+    return x + 1 if opt_state else x

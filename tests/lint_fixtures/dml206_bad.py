@@ -1,0 +1,32 @@
+"""DML206 bad corpus: scans over layer stacks with no remat policy.
+Expected findings: 3 (lines marked BAD)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DecoderBlock(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x + nn.Dense(16)(x)
+
+
+def forward(x, stacked_params):
+    def body(carry, layer_params):
+        block = DecoderBlock()
+        return block.apply({"params": layer_params}, carry), None
+
+    out, _ = jax.lax.scan(body, x, stacked_params)  # BAD: no remat on layers
+    return out
+
+
+def forward_lambda(x, stacked, apply_layer):
+    out, _ = jax.lax.scan(  # BAD: lambda body calls a layer, no remat
+        lambda c, p: (apply_layer(c, p), None), x, stacked
+    )
+    return out
+
+
+def forward_nn_scan(x):
+    scanned = nn.scan(DecoderBlock, variable_axes={"params": 0}, length=8)  # BAD
+    return scanned()(x)

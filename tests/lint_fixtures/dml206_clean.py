@@ -1,0 +1,61 @@
+"""DML206 clean corpus: remat present in every form, and non-layer scans
+that must never match."""
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DecoderBlock(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x + nn.Dense(16)(x)
+
+
+def forward_checkpointed_body(x, stacked_params):
+    @jax.checkpoint
+    def body(carry, layer_params):
+        return DecoderBlock().apply({"params": layer_params}, carry), None
+
+    out, _ = jax.lax.scan(body, x, stacked_params)
+    return out
+
+
+def forward_wrapped_at_site(x, stacked_params, body):
+    out, _ = jax.lax.scan(jax.checkpoint(body), x, stacked_params)
+    return out
+
+
+def forward_remat_class(x):
+    scanned = nn.scan(nn.remat(DecoderBlock), variable_axes={"params": 0}, length=8)
+    return scanned()(x)
+
+
+def forward_remat_binding(x, stacked_params):
+    block = nn.remat(DecoderBlock)
+
+    def body(carry, layer_params):
+        return block(name="b").apply({"params": layer_params}, carry), None
+
+    out, _ = jax.lax.scan(jax.checkpoint(body), x, stacked_params)
+    return out
+
+
+def decode_loop(model, params, cache, tokens):
+    # scan over DECODE STEPS, not layers — no remat wanted here
+    def step(carry, tok):
+        cache, prev = carry
+        logits, cache = model.apply({"params": params}, prev, cache=cache)
+        return (cache, tok), logits
+
+    out, _ = jax.lax.scan(step, (cache, tokens[0]), tokens)
+    return out
+
+
+def chunked_reduce(xs):
+    def body(acc, x):
+        return acc + jnp.sum(x), None
+
+    total, _ = jax.lax.scan(body, 0.0, xs)
+    return total

@@ -1,0 +1,132 @@
+"""``bench.py --gate`` — the perf regression gate over kernel receipts.
+
+The gate compares the flat ``gate`` section (kernel speedups, accept rate)
+plus the goodput fraction of the current run against the last committed
+``BENCH_kernels_*.json`` receipt: PASS when nothing dropped more than the
+tolerance, FAIL on a significant drop OR a metric that silently vanished
+(the r05 all-null receipt must never slip through again).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _GATE_TOLERANCE, _gate_metrics, gate_main, run_gate
+
+RECEIPT = {
+    "flash_attn": {"fwd_speedup_vs_unfused": 1.6},
+    "gate": {
+        "flash_fwd_speedup_vs_unfused": 1.6,
+        "flash_fwdbwd_speedup_vs_unfused": 1.7,
+        "spec_decode_speedup_vs_plain": 1.5,
+        "spec_decode_accept_rate": 0.9,
+        "int8_decode_speedup": 1.25,
+    },
+}
+
+
+def _write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+def test_gate_passes_against_itself(tmp_path, capsys):
+    base = _write(tmp_path, "BENCH_kernels_base.json", RECEIPT)
+    assert run_gate(base, current=dict(RECEIPT)) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_gate_passes_within_tolerance(tmp_path):
+    current = json.loads(json.dumps(RECEIPT))
+    for k in current["gate"]:
+        current["gate"][k] *= 1 - _GATE_TOLERANCE * 0.5  # half the allowed drop
+    base = _write(tmp_path, "BENCH_kernels_base.json", RECEIPT)
+    assert run_gate(base, current=current) == 0
+
+
+def test_gate_fails_against_doctored_regression(tmp_path, capsys):
+    doctored = json.loads(json.dumps(RECEIPT))
+    doctored["gate"]["flash_fwdbwd_speedup_vs_unfused"] = 0.48  # the old losing kernel
+    doctored["gate"]["spec_decode_accept_rate"] = 0.0
+    base = _write(tmp_path, "BENCH_kernels_base.json", RECEIPT)
+    cur = _write(tmp_path, "doctored.json", doctored)
+    assert run_gate(base, current=cur) == 1  # path form, like the CLI
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "flash_fwdbwd_speedup_vs_unfused" in out
+    assert "spec_decode_accept_rate" in out
+
+
+def test_gate_fails_on_silently_missing_metric(tmp_path, capsys):
+    """An all-null / truncated current receipt is a FAILURE, not a pass —
+    exactly how the r05 receipt went dark without anyone noticing."""
+    current = {"gate": {k: v for k, v in RECEIPT["gate"].items() if "int8" not in k}}
+    base = _write(tmp_path, "BENCH_kernels_base.json", RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_improvements_always_pass(tmp_path):
+    current = json.loads(json.dumps(RECEIPT))
+    for k in current["gate"]:
+        current["gate"][k] *= 2.0
+    base = _write(tmp_path, "BENCH_kernels_base.json", RECEIPT)
+    assert run_gate(base, current=current) == 0
+
+
+def test_gate_compares_goodput_when_present(tmp_path):
+    base_r = json.loads(json.dumps(RECEIPT))
+    base_r["goodput_frac"] = 0.8
+    cur = json.loads(json.dumps(base_r))
+    cur["goodput_frac"] = 0.5  # productive fraction collapsed
+    base = _write(tmp_path, "base.json", base_r)
+    assert run_gate(base, current=cur) == 1
+    cur["goodput_frac"] = 0.78
+    assert run_gate(base, current=cur) == 0
+
+
+def test_gate_metrics_reads_driver_wrapped_receipts():
+    """Full bench.py receipts are committed driver-wrapped ({"parsed": ...});
+    the goodput key must be found in either shape."""
+    wrapped = {"parsed": {"goodput_frac": 0.7}, "gate": {"x": 1.0}}
+    assert _gate_metrics(wrapped) == {"x": 1.0, "goodput_frac": 0.7}
+    bare = {"goodput_frac": 0.7}
+    assert _gate_metrics(bare) == {"goodput_frac": 0.7}
+
+
+def test_gate_main_flags(tmp_path):
+    doctored = json.loads(json.dumps(RECEIPT))
+    doctored["gate"]["int8_decode_speedup"] = 0.5
+    base = _write(tmp_path, "base.json", RECEIPT)
+    cur = _write(tmp_path, "cur.json", doctored)
+    assert gate_main(["--gate", "--baseline", base, "--current", cur]) == 1
+    # a huge tolerance waves the same drop through
+    assert gate_main(["--gate", "--baseline", base, "--current", cur, "--tolerance", "0.9"]) == 0
+
+
+def test_gate_no_baseline_is_an_error(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        run_gate(str(tmp_path / "missing.json"), current={})
+    # a baseline with no comparable metrics cannot vouch for anything
+    empty = _write(tmp_path, "empty.json", {"gate": {}})
+    assert run_gate(empty, current=dict(RECEIPT)) == 2
+
+
+def test_committed_receipt_satisfies_the_gate():
+    """The committed PR 6 receipt must pass its own gate — and its gate
+    section must show the three reclaimed kernels above their floors."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_kernels_pr06.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    gate = json.load(open(path))["gate"]
+    assert gate["flash_fwd_speedup_vs_unfused"] >= 1.0
+    assert gate["flash_fwdbwd_speedup_vs_unfused"] >= 1.0
+    assert gate["spec_decode_speedup_vs_plain"] >= 1.3
+    assert gate["spec_decode_accept_rate"] >= 0.6
+    assert gate["int8_decode_speedup"] >= 1.2

@@ -329,6 +329,33 @@ def test_ragged_beam_rows_match_unpadded():
     np.testing.assert_allclose(np.asarray(scores), [float(s1[0]), float(s2[0])], atol=1e-5)
 
 
+def test_rewind_cache_masks_exactly():
+    """rewind_cache is ONE masked select over the tree: slots at position
+    >= fill_len zero out, slots below are untouched bit for bit — with a
+    per-row [B] fill, a scalar fill, and under jit (traced fill)."""
+    from dmlcloud_tpu.models.generate import rewind_cache
+
+    rng = np.random.RandomState(0)
+    cache = {
+        "layer_0": {
+            "k": jnp.asarray(rng.randn(2, 16, 1, 4), jnp.float32),
+            "v": jnp.asarray(rng.randn(2, 16, 1, 4), jnp.float32),
+        }
+    }
+    fill = jnp.asarray([5, 11], jnp.int32)
+    for rewound in (rewind_cache(cache, fill), jax.jit(rewind_cache)(cache, fill)):
+        for name in ("k", "v"):
+            got = np.asarray(rewound["layer_0"][name])
+            want = np.asarray(cache["layer_0"][name]).copy()
+            want[0, 5:] = 0
+            want[1, 11:] = 0
+            np.testing.assert_array_equal(got, want)
+    # scalar fill broadcasts to every row
+    got = np.asarray(rewind_cache(cache, 3)["layer_0"]["k"])
+    assert (got[:, 3:] == 0).all()
+    np.testing.assert_array_equal(got[:, :3], np.asarray(cache["layer_0"]["k"])[:, :3])
+
+
 def test_attend_len_bounds_cache_reads():
     """With attend_len set, slots past it must never be READ: poison the
     cache tail with NaN and the logits must stay finite and equal to the

@@ -40,6 +40,8 @@ BAD_EXPECT = {
     "DML202": 3,
     "DML203": 2,
     "DML204": 3,
+    "DML205": 3,
+    "DML206": 3,
     "DML301": 2,
     "DML302": 2,
 }

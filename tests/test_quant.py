@@ -90,3 +90,52 @@ def test_quantized_logits_close_to_full():
     quant = np.asarray(model.apply({"params": deq}, tokens))
     denom = np.abs(full).max()
     assert np.abs(quant - full).max() / denom < 0.05
+
+
+def test_prepare_decode_params_is_exact_and_stays_quantized():
+    """prepare_decode_params pre-pays the off-TPU GEMM-operand widen ONCE:
+    kernels stay QuantizedTensor (scales still applied to the accumulator
+    in the fused dot), q widens to fp32 exactly (int8 -> fp32 is lossless),
+    and decode output is bit-identical to passing the raw int8 tree."""
+    from dmlcloud_tpu.models.generate import generate
+    from dmlcloud_tpu.models.quant import prepare_decode_params
+
+    model, params = _tiny_lm()
+    qparams = quantize_tree(params)
+    prepared = prepare_decode_params(qparams, jnp.float32)
+
+    is_qt = lambda x: isinstance(x, QuantizedTensor)
+    q_leaves = [x for x in jax.tree_util.tree_leaves(prepared, is_leaf=is_qt) if is_qt(x)]
+    raw_leaves = [x for x in jax.tree_util.tree_leaves(qparams, is_leaf=is_qt) if is_qt(x)]
+    assert q_leaves, "prepared tree lost its quantized kernels"
+    assert len(q_leaves) == len(raw_leaves)
+    for wide, raw in zip(q_leaves, raw_leaves):
+        assert wide.q.dtype == jnp.float32  # off-TPU operand dtype (CPU CI)
+        np.testing.assert_array_equal(np.asarray(wide.q), np.asarray(raw.q, np.float32))
+        np.testing.assert_array_equal(np.asarray(wide.scale), np.asarray(raw.scale))
+
+    prompt = jnp.asarray(np.random.RandomState(3).randint(0, 64, (2, 6)), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, qparams, prompt, max_new_tokens=8)),
+        np.asarray(generate(model, prepared, prompt, max_new_tokens=8)),
+    )
+
+
+def test_widen_quant_tree_inside_jit_matches_per_step_path():
+    """The in-program widen (decode entry points call it before the loop)
+    must be a pure layout change: same QuantizedTensor structure, same
+    values, fp32 q — and non-quantized leaves pass through untouched."""
+    from dmlcloud_tpu.models.quant import widen_quant_tree
+
+    rng = np.random.RandomState(4)
+    tree = {
+        "dense": {"kernel": quantize(jnp.asarray(rng.randn(16, 8), jnp.float32))},
+        "bias": jnp.asarray(rng.randn(8), jnp.float32),
+    }
+    out = jax.jit(widen_quant_tree)(tree)
+    assert isinstance(out["dense"]["kernel"], QuantizedTensor)
+    assert out["dense"]["kernel"].q.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(out["dense"]["kernel"].q), np.asarray(tree["dense"]["kernel"].q, np.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(out["bias"]), np.asarray(tree["bias"]))

@@ -211,27 +211,134 @@ def test_return_stats_consistency(models):
     target, tparams, draft, dparams = models
     k = 3
     prompt = jnp.asarray(np.random.RandomState(5).randint(0, 48, (3, 9)), jnp.int32)
-    toks, (rounds, generated) = speculative_generate(
+    toks, (rounds, generated, accepted) = speculative_generate(
         target, tparams, draft, dparams, prompt, max_new_tokens=18, k=k, return_stats=True
     )
     want = np.asarray(
         speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=18, k=k)
     )
     np.testing.assert_array_equal(np.asarray(toks), want)  # stats don't change tokens
-    rounds, generated = np.asarray(rounds), np.asarray(generated)
+    rounds, generated, accepted = np.asarray(rounds), np.asarray(generated), np.asarray(accepted)
     # no eos id in play: full fill, plus up to k overshoot in the last round
     assert ((generated >= 18) & (generated <= 18 + k)).all(), generated
     # each round advances 1..k+1 positions (first token costs no round)
     assert (rounds >= np.ceil((generated - 1) / (k + 1))).all(), (rounds, generated)
     assert (rounds <= generated - 1).all(), (rounds, generated)
-    rate = (generated - 1 - rounds) / (rounds * k)
+    # absent eos, the exact counter and the advance algebra must agree
+    np.testing.assert_array_equal(accepted, generated - 1 - rounds)
+    rate = accepted / (rounds * k)
     assert ((rate >= 0) & (rate <= 1)).all(), rate
 
     # a perfect draft (the target itself) accepts every proposal
-    _, (p_rounds, p_generated) = speculative_generate(
+    _, (p_rounds, p_generated, p_accepted) = speculative_generate(
         target, tparams, target, tparams, prompt, max_new_tokens=18, k=k, return_stats=True
     )
-    p_rounds, p_generated = np.asarray(p_rounds), np.asarray(p_generated)
-    p_rate = (p_generated - 1 - p_rounds) / (p_rounds * k)
-    np.testing.assert_allclose(p_rate, 1.0)
+    p_rounds, p_generated, p_accepted = (
+        np.asarray(p_rounds), np.asarray(p_generated), np.asarray(p_accepted)
+    )
+    np.testing.assert_allclose(p_accepted / (p_rounds * k), 1.0)
     assert (p_rounds <= rounds).all(), (p_rounds, rounds)
+
+
+def _np_reference_counters(target, tparams, draft, dparams, prompt_row, max_new, k):
+    """Greedy speculative decoding re-implemented with full-sequence
+    (cache-free) model applications and NumPy argmax — the independent
+    reference for the on-device round/accept counters."""
+
+    def tlogits(seq):
+        return np.asarray(target.apply({"params": tparams}, jnp.asarray(seq, jnp.int32)[None])[0])
+
+    def dlogits(seq):
+        return np.asarray(draft.apply({"params": dparams}, jnp.asarray(seq, jnp.int32)[None])[0])
+
+    y = [int(x) for x in prompt_row]
+    t = len(y)
+    y.append(int(np.argmax(tlogits(y)[-1])))  # first token costs no round
+    rounds = accepted = 0
+    pos = t + 1
+    while pos < t + max_new:
+        rounds += 1
+        props, ctx = [], list(y)
+        for _ in range(k):
+            nxt = int(np.argmax(dlogits(ctx)[-1]))
+            props.append(nxt)
+            ctx.append(nxt)
+        tl = tlogits(y + props)  # row pos-1+i predicts position pos+i
+        n_acc, new = 0, []
+        for i in range(k):
+            t_i = int(np.argmax(tl[pos - 1 + i]))
+            if props[i] == t_i:
+                n_acc += 1
+                new.append(props[i])
+            else:
+                new.append(t_i)
+                break
+        else:
+            new.append(int(np.argmax(tl[pos - 1 + k])))  # bonus token
+        accepted += n_acc
+        y.extend(new)
+        pos += len(new)
+    return rounds, pos - t, accepted
+
+
+def test_accept_counter_matches_numpy_reference(models):
+    """The on-device rounds/advanced/accepted counters must be EXACT —
+    equal to a from-scratch NumPy reference of the greedy round structure,
+    row by row (the r01-r05 receipts recorded accept 0.0 because the
+    observable was never pinned to an independent implementation)."""
+    target, tparams, draft, dparams = models
+    k, max_new = 3, 14
+    prompt = jnp.asarray(np.random.RandomState(11).randint(0, 48, (3, 8)), jnp.int32)
+    _, (rounds, advanced, accepted) = speculative_generate(
+        target, tparams, draft, dparams, prompt, max_new_tokens=max_new, k=k, return_stats=True
+    )
+    rounds, advanced, accepted = (np.asarray(x) for x in (rounds, advanced, accepted))
+    for row in range(prompt.shape[0]):
+        want = _np_reference_counters(
+            target, tparams, draft, dparams, np.asarray(prompt)[row], max_new, k
+        )
+        got = (int(rounds[row]), int(advanced[row]), int(accepted[row]))
+        assert got == want, f"row {row}: device counters {got} != numpy reference {want}"
+
+
+def test_rewound_cache_bit_identical_at_accepted_prefix(models):
+    """return_cache=True caches are rewound with ONE masked-select primitive:
+    the stale speculative tail must be exactly zero, and the valid prefix
+    must be bit-identical across runs with DIFFERENT drafts (different
+    rejection patterns, different stale slots — same greedy tokens)."""
+    target, tparams, draft, dparams = models
+    k, max_new = 3, 12
+    prompt = jnp.asarray(np.random.RandomState(12).randint(0, 48, (2, 7)), jnp.int32)
+    t = prompt.shape[1]
+
+    toks_a, (_, fill_a, _), (tcache_a, dcache_a) = speculative_generate(
+        target, tparams, draft, dparams, prompt, max_new_tokens=max_new, k=k,
+        return_stats=True, return_cache=True,
+    )
+    toks_b, (_, fill_b, _), (tcache_b, _) = speculative_generate(
+        target, tparams, target, tparams, prompt, max_new_tokens=max_new, k=k,
+        return_stats=True, return_cache=True,
+    )
+    np.testing.assert_array_equal(np.asarray(toks_a), np.asarray(toks_b))
+
+    # the contract: advanced - 1 valid positions per row (the final token's
+    # slot is zeroed — the loop's overwrite invariant never certifies it)
+    valid_a = np.asarray(fill_a) + t - 1
+    for cache in (tcache_a, dcache_a):
+        for leaf in jax.tree_util.tree_leaves(cache):
+            arr = np.asarray(leaf)  # [B, S, KH, D]
+            for row in range(arr.shape[0]):
+                assert (arr[row, valid_a[row]:] == 0).all(), "stale tail not rewound"
+    # valid prefix: bit-identical target caches wherever both runs decoded
+    common = np.minimum(valid_a, np.asarray(fill_b) + t - 1)
+    flat_a = jax.tree_util.tree_leaves(tcache_a)
+    flat_b = jax.tree_util.tree_leaves(tcache_b)
+    assert len(flat_a) == len(flat_b) and len(flat_a) > 0
+    for la, lb in zip(flat_a, flat_b):
+        a, b = np.asarray(la), np.asarray(lb)
+        assert a.ndim == 4, "return_cache leaves must be [B, S, KH, D]"
+        for row in range(a.shape[0]):
+            np.testing.assert_array_equal(
+                a[row, : common[row]], b[row, : common[row]],
+                err_msg="accepted-prefix cache slots differ between drafts",
+            )
