@@ -770,6 +770,205 @@ def bench_kernels(timeout_s: int = 1800) -> dict | None:
     return None
 
 
+# ------------------------------------------------------- elastic drill bench
+
+_ELASTIC_MARKER = "ELASTIC_BENCH_RESULTS "
+
+#: drill geometry: 2 epochs of 16 batches, step-save every 2, SIGTERM after
+#: batch 7 -> drain at the step-8 boundary, resume on HALF the devices
+_ELASTIC_N_BATCHES = 16
+_ELASTIC_SAVE_EVERY = 2
+_ELASTIC_KILL_AFTER = 7
+_ELASTIC_EPOCHS = 2
+
+
+def elastic_child_main():
+    """The preemption drill as a benchmark (doc/elasticity.md): train on a
+    4-device mesh, deliver a REAL SIGTERM mid-epoch, drain at the next
+    step-save boundary, then resume the SAME run dir on a 2-device mesh and
+    finish. Emits one marker line of JSON — the source of the
+    ``BENCH_elastic_*.json`` receipts:
+
+    - ``save_on_preempt_latency_s``  the drain's final committed save
+    - ``time_to_resume_s``           resumed run start -> first resumed
+                                     optimizer step dispatched (restore +
+                                     resharding + data fast-forward)
+    - ``steps_replayed``             final step count vs the exact-resume
+                                     expectation (positive = replayed
+                                     batches, negative = skipped)
+
+    Needs ``XLA_FLAGS=--xla_force_host_platform_device_count=4`` in the
+    environment (``bench_elastic`` sets it) — the flag must precede backend
+    init, which is why this runs as a child."""
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import optax
+
+    import dmlcloud_tpu as dml
+    from dmlcloud_tpu.checkpoint import read_requeue_verdict
+    from dmlcloud_tpu.data import DataPipeline
+    from dmlcloud_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    xs = rng.randn(_ELASTIC_N_BATCHES, 16, 4).astype(np.float32)
+    batches = [{"x": x, "y": x @ w_true} for x in xs]
+
+    class SigtermSource:
+        """Yields the drill batches; delivers SIGTERM to this process after
+        batch ``kill_after`` (the production preemption path, handler and
+        all). Records the wall time of every yield so the resumed run's
+        first post-fast-forward batch timestamps time-to-resume."""
+
+        def __init__(self, kill_after=None):
+            self.kill_after = kill_after
+            self.fired = False
+            self.yield_times: list = []
+
+        def __iter__(self):
+            for i, b in enumerate(batches):
+                self.yield_times.append(time.perf_counter())
+                yield b
+                if self.kill_after is not None and not self.fired and i + 1 == self.kill_after:
+                    self.fired = True
+                    os.kill(os.getpid(), _signal.SIGTERM)
+
+        def __len__(self):
+            return len(batches)
+
+    class DrillStage(dml.TrainValStage):
+        def __init__(self, source):
+            super().__init__()
+            self._source = source
+
+        def checkpoint_every_steps(self):
+            return _ELASTIC_SAVE_EVERY
+
+        def device_prefetch(self):
+            return 0  # keep batch consumption aligned with optimizer steps
+
+        def pre_stage(self):
+            self.pipeline.register_model(
+                "lin",
+                apply_fn=lambda p, x: x @ p["w"],
+                params={"w": jnp.zeros((4, 1))},
+                verbose=False,
+            )
+            self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
+            self.pipeline.register_dataset(
+                "train", DataPipeline.from_source(self._source), verbose=False
+            )
+
+        def step(self, state, batch):
+            return jnp.mean((state.apply_fn(state.params, batch["x"]) - batch["y"]) ** 2)
+
+        def val_epoch(self):
+            pass
+
+    def run(ckpt_dir, source, n_devices, preemptible=False):
+        pipe = dml.TrainingPipeline(name="elastic-drill")
+        pipe.set_mesh(
+            mesh_lib.create_mesh({"data": n_devices}, devices=jax.devices()[:n_devices])
+        )
+        pipe.enable_checkpointing(str(ckpt_dir), resume=True)
+        if preemptible:
+            pipe.enable_preemption_handling(signals=("SIGTERM",))
+        stage = DrillStage(source)
+        pipe.append_stage(stage, max_epochs=_ELASTIC_EPOCHS, name="drill")
+        pipe.run()
+        pipe.checkpoint_dir.close()
+        return pipe, stage
+
+    workdir = tempfile.mkdtemp(prefix="dml-elastic-bench-")
+    try:
+        # phase A: preempted mid-epoch on data=4
+        t_a = time.perf_counter()
+        pipe1, stage1 = run(os.path.join(workdir, "run"), SigtermSource(_ELASTIC_KILL_AFTER), 4, preemptible=True)
+        phase_a_s = time.perf_counter() - t_a
+        verdict = read_requeue_verdict(pipe1.checkpoint_dir.path) or {}
+        drained_step = int(jax.device_get(stage1.state.step))
+
+        # phase B: the requeue — SAME run dir, HALF the devices
+        source_b = SigtermSource()
+        t_resume = time.perf_counter()
+        pipe2, stage2 = run(pipe1.checkpoint_dir.path, source_b, 2)
+        phase_b_s = time.perf_counter() - t_resume
+        final_step = int(jax.device_get(stage2.state.step))
+
+        # the resumed run's data fast-forward consumes the already-seen
+        # prefix from the source; its (drained_step+1)-th yield is the first
+        # batch the FIRST RESUMED optimizer step consumes
+        first_new = (
+            source_b.yield_times[drained_step]
+            if len(source_b.yield_times) > drained_step
+            else t_resume + phase_b_s
+        )
+        steps_replayed = final_step - _ELASTIC_EPOCHS * _ELASTIC_N_BATCHES
+        results = {
+            "workload": {
+                "n_batches": _ELASTIC_N_BATCHES,
+                "epochs": _ELASTIC_EPOCHS,
+                "save_every_steps": _ELASTIC_SAVE_EVERY,
+                "kill_after_batch": _ELASTIC_KILL_AFTER,
+                "devices_before": 4,
+                "devices_after": 2,
+            },
+            "drained_step": drained_step,
+            "final_step": final_step,
+            "requeue_verdict": {k: verdict.get(k) for k in ("requeue", "kind", "mid_epoch")},
+            "steps_replayed": steps_replayed,
+            "save_on_preempt_latency_s": verdict.get("save_on_preempt_latency_s"),
+            "time_to_resume_s": round(first_new - t_resume, 4),
+            "phase_a_wall_s": round(phase_a_s, 3),
+            "phase_b_wall_s": round(phase_b_s, 3),
+        }
+        lat = results["save_on_preempt_latency_s"]
+        results["gate"] = {
+            # exact data-order resumption is pass/fail: 1.0 only when not a
+            # single optimizer step was replayed or skipped AND the drain
+            # left a resumable preemption verdict
+            "elastic_exact_resume": float(
+                steps_replayed == 0 and verdict.get("requeue") is True
+            ),
+            "elastic_save_on_preempt_latency_s": lat,
+            "elastic_time_to_resume_s": results["time_to_resume_s"],
+        }
+        print(_ELASTIC_MARKER + json.dumps(results), flush=True)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_elastic(timeout_s: int = 900) -> dict | None:
+    """Run the preemption drill in a child pinned to 4 fake CPU devices;
+    returns its results dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--elastic-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_ELASTIC_MARKER):
+            try:
+                return json.loads(line[len(_ELASTIC_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 # --------------------------------------------------------------- perf gate
 
 #: relative drop in a gate metric that fails the gate (15%: comfortably
@@ -780,6 +979,18 @@ _GATE_TOLERANCE = 0.15
 #: goodput-ledger keys compared when both receipts carry them (the full
 #: bench.py receipts do; kernel receipts usually don't)
 _GATE_GOODPUT_KEYS = ("goodput_frac",)
+
+#: gate metrics where SMALLER is better (the elastic drill's latencies);
+#: everything else is a speedup/ratio where bigger is better
+_GATE_LOWER_IS_BETTER = frozenset(
+    {"elastic_save_on_preempt_latency_s", "elastic_time_to_resume_s"}
+)
+
+#: relative GROWTH allowed for the lower-is-better latency metrics (100%:
+#: wall-clock latencies on a shared CI box are far noisier than kernel
+#: ratios; the gate exists to catch the async save turning sync or the
+#: resume path re-running whole epochs — order-of-magnitude breakage)
+_GATE_LATENCY_TOLERANCE = 1.0
 
 
 def _gate_metrics(receipt: dict) -> dict:
@@ -798,12 +1009,16 @@ def _gate_metrics(receipt: dict) -> dict:
     return out
 
 
-def _latest_kernels_receipt() -> str | None:
+def _latest_receipt(prefix: str) -> str | None:
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
-    receipts = sorted(glob.glob(os.path.join(here, "BENCH_kernels_*.json")))
+    receipts = sorted(glob.glob(os.path.join(here, f"BENCH_{prefix}_*.json")))
     return receipts[-1] if receipts else None
+
+
+def _latest_kernels_receipt() -> str | None:
+    return _latest_receipt("kernels")
 
 
 def run_gate(baseline_path: str, current: dict | str | None = None,
@@ -841,14 +1056,20 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
             failures.append(k)
             print(f"  {k:<{width}}  baseline {bv:8.3f}  current     MISSING  FAIL")
             continue
-        drop = (bv - cv) / bv if bv > 0 else 0.0
-        bad = drop > tolerance
-        if bad:
-            failures.append(k)
+        if k in _GATE_LOWER_IS_BETTER:
+            # a latency: regression is GROWTH, judged against the (wide)
+            # latency tolerance — wall clock on CI is noisy
+            drop = (cv - bv) / bv if bv > 0 else 0.0
+            bad = drop > max(tolerance, _GATE_LATENCY_TOLERANCE)
+        else:
+            drop = (bv - cv) / bv if bv > 0 else 0.0
+            bad = drop > tolerance
         print(
             f"  {k:<{width}}  baseline {bv:8.3f}  current {cv:8.3f}  "
             f"{'FAIL' if bad else 'ok':>4}  ({-drop:+.1%})"
         )
+        if bad:
+            failures.append(k)
     if failures:
         print(f"gate: FAIL — {len(failures)} metric(s) regressed: {', '.join(failures)}")
         return 1
@@ -857,9 +1078,14 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
 
 
 def gate_main(argv: list) -> int:
-    """``bench.py --gate [--baseline B.json] [--current C.json]
-    [--tolerance 0.15]`` — CI regression gate over the committed kernel
-    receipts (scripts/perf_gate.sh wires it into the lint-gate flow)."""
+    """``bench.py --gate [--suite kernels|elastic|all] [--baseline B.json]
+    [--current C.json] [--tolerance 0.15]`` — CI regression gate over the
+    committed receipts (scripts/perf_gate.sh wires it into the lint-gate
+    flow). The ``kernels`` suite (default) measures the kernel A/Bs; the
+    ``elastic`` suite runs the preemption drill and compares its metrics
+    against the last committed ``BENCH_elastic_*.json`` (exact resume,
+    save-on-preempt latency, time-to-resume — a missing metric FAILS, same
+    as the kernel gate); ``all`` chains both and fails on the worst."""
 
     def _opt(flag, default=None):
         if flag in argv:
@@ -868,12 +1094,35 @@ def gate_main(argv: list) -> int:
                 return argv[i + 1]
         return default
 
-    baseline = _opt("--baseline") or _latest_kernels_receipt()
-    if baseline is None:
-        print("gate: FAIL — no --baseline and no committed BENCH_kernels_*.json", file=sys.stderr)
-        return 2
+    suite = _opt("--suite", "kernels")
     tolerance = float(_opt("--tolerance", _GATE_TOLERANCE))
-    return run_gate(baseline, _opt("--current"), tolerance)
+    if suite not in ("kernels", "elastic", "all"):
+        print(f"gate: unknown --suite {suite!r} (kernels|elastic|all)", file=sys.stderr)
+        return 2
+
+    rcs = []
+    if suite in ("kernels", "all"):
+        baseline = _opt("--baseline") if suite == "kernels" else None
+        baseline = baseline or _latest_kernels_receipt()
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_kernels_*.json", file=sys.stderr)
+            return 2
+        rcs.append(run_gate(baseline, _opt("--current") if suite == "kernels" else None, tolerance))
+    if suite in ("elastic", "all"):
+        baseline = _opt("--baseline") if suite == "elastic" else None
+        baseline = baseline or _latest_receipt("elastic")
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_elastic_*.json", file=sys.stderr)
+            return 2
+        current = _opt("--current") if suite == "elastic" else None
+        if current is None:
+            print("gate: running the preemption drill (elastic suite child)...", file=sys.stderr)
+            current = bench_elastic()
+            if current is None:
+                print("gate: FAIL — elastic drill child produced no results", file=sys.stderr)
+                return 2
+        rcs.append(run_gate(baseline, current, tolerance))
+    return max(rcs)
 
 
 _METRICS_WORKER = """
@@ -1872,6 +2121,8 @@ if __name__ == "__main__":
         compile_worker_main()
     elif "--kernels-child" in sys.argv[1:]:
         kernels_child_main()
+    elif "--elastic-child" in sys.argv[1:]:
+        elastic_child_main()
     elif "--probe-child" in sys.argv[1:]:
         probe_child_main()
     elif "--gate" in sys.argv[1:]:

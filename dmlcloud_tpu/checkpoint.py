@@ -16,9 +16,11 @@ collective.
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 import string
+import time
 from datetime import datetime
 from pathlib import Path
 from typing import Any
@@ -27,6 +29,8 @@ from etils import epath
 
 from .utils import slurm
 from .utils.config import Config, as_config
+
+_logger = logging.getLogger("dmlcloud_tpu")
 
 
 def as_run_path(path: Any) -> epath.Path:
@@ -100,6 +104,51 @@ def atomic_write_text(target: epath.Path, text: str) -> None:
 #: checkpoint.py:58-60).
 INDICATOR_FILE = ".dmlcloud_tpu"
 
+#: The requeue-verdict file a run leaves behind (doc/elasticity.md): one JSON
+#: object answering the only question the requeue wrapper asks — should this
+#: job be resubmitted, and why.
+REQUEUE_FILE = "requeue.json"
+
+
+def write_requeue_verdict(
+    run_dir: Any, requeue: bool, reason: str, kind: str, **extra
+) -> None:
+    """Atomically write the requeue verdict for ``run_dir`` (schema v1)::
+
+        {"v": 1, "requeue": true|false, "kind": "preemption"|"hang"|
+         "exception"|"user-interrupt"|"completed", "reason": "...",
+         "written_at": iso8601, ...extra}
+
+    Call from ONE process (the root). ``extra`` carries kind-specific fields
+    (epoch/global_step/save latency for preemptions, stragglers for hangs).
+    A requeue wrapper (Slurm epilog, k8s controller) reads this instead of
+    guessing from exit codes; see doc/elasticity.md for the contract."""
+    import json
+
+    record = {
+        "v": 1,
+        "requeue": bool(requeue),
+        "kind": kind,
+        "reason": reason,
+        "written_at": datetime.now().isoformat(timespec="seconds"),
+    }
+    record.update(extra)
+    target = as_run_path(run_dir) / REQUEUE_FILE
+    atomic_write_text(target, json.dumps(record, indent=1))
+
+
+def read_requeue_verdict(run_dir: Any) -> dict | None:
+    """The run's requeue verdict, or None when absent/corrupt."""
+    import json
+
+    try:
+        raw = json.loads((as_run_path(run_dir) / REQUEUE_FILE).read_text())
+        if raw.get("v") == 1 and isinstance(raw.get("requeue"), bool):
+            return raw
+    except Exception:
+        pass
+    return None
+
 
 def sanitize_filename(filename: str) -> str:
     return filename.replace("/", "_")
@@ -164,6 +213,12 @@ class CheckpointDir:
         self._retention_policies: dict[str | None, Any] = {}
         #: scope -> {step: metrics dict} backing the shim BestN ranking
         self._policy_metrics: dict[str | None, dict[int, dict]] = {}
+        #: transient-filesystem-error policy for Orbax save dispatch: total
+        #: attempts and the first backoff (doubles per retry, capped at 8s).
+        #: Instance attributes so tests (and callers on flaky object stores)
+        #: can tune them without process-global state.
+        self.save_retries = 3
+        self.save_backoff_s = 0.5
 
     # -- contract files -----------------------------------------------------
     @property
@@ -181,6 +236,10 @@ class CheckpointDir:
     @property
     def slurm_file(self) -> epath.Path:
         return self.path / ".slurm-jobid"
+
+    @property
+    def requeue_file(self) -> epath.Path:
+        return self.path / REQUEUE_FILE
 
     @property
     def state_dir(self) -> epath.Path:
@@ -282,15 +341,161 @@ class CheckpointDir:
         return self._state_managers[scope]
 
     def save_state(self, step: int, state: Any, scope: str | None = None, **kwargs) -> None:
-        """Save a pytree of (possibly sharded) arrays under ``state/<step>``."""
+        """Save a pytree of (possibly sharded) arrays under ``state/<step>``.
+
+        Two durability features ride every save:
+
+        - **bounded retry**: a transient filesystem error (``OSError``) at
+          save dispatch is retried ``save_retries`` times with exponential
+          backoff before the ORIGINAL error surfaces — an NFS hiccup or GCS
+          503 at minute 590 of a 600-minute job must not cost the job.
+        - **sharding sidecar**: the root records each leaf's PartitionSpec
+          and the mesh shape (``meta/_sharding/<scope>/<step>.json``) so a
+          later :meth:`restore_state` can rebuild shardings for a DIFFERENT
+          mesh — the elastic-resume contract (doc/elasticity.md)."""
         import orbax.checkpoint as ocp
 
         from .telemetry import journal as _journal
 
         with _journal.span("checkpoint", label=scope, op="save", step=int(step)):
-            self.state_manager(scope).save(step, args=ocp.args.StandardSave(state), **kwargs)
+            self._retry_transient(
+                lambda: self.state_manager(scope).save(
+                    step, args=ocp.args.StandardSave(state), **kwargs
+                ),
+                what=f"save of step {step} (scope {scope!r})",
+            )
+        self._write_sharding_sidecar(scope, int(step), state)
         if scope in self._retention_policies:
             self._apply_retention(scope, step, kwargs.get("metrics"))
+
+    def _retry_transient(self, fn, what: str):
+        """Run ``fn``, retrying transient filesystem errors (``OSError``)
+        with bounded exponential backoff; the original error re-raises after
+        the last attempt."""
+        attempts = max(int(self.save_retries), 1)
+        delay = float(self.save_backoff_s)
+        first: OSError | None = None
+        for attempt in range(1, attempts + 1):
+            try:
+                return fn()
+            except OSError as e:
+                first = first or e
+                if attempt == attempts:
+                    break
+                _logger.warning(
+                    "checkpoint %s hit a transient filesystem error (%s: %s); "
+                    "retry %d/%d in %.1fs",
+                    what, type(e).__name__, e, attempt, attempts - 1, delay,
+                )
+                time.sleep(delay)
+                delay = min(delay * 2, 8.0)
+        raise first
+
+    # -- sharding sidecar (elastic resharded restore; doc/elasticity.md) -----
+    def _sharding_sidecar_file(self, scope: str | None, step: int) -> epath.Path:
+        # a dedicated subtree: ``meta/<scope>/`` belongs to the stage's
+        # resume sidecars (stage.py _write_resume_sidecar enumerates it)
+        return self.path / "meta" / "_sharding" / (scope or "_root") / f"{int(step)}.json"
+
+    def _write_sharding_sidecar(self, scope: str | None, step: int, state: Any) -> None:
+        """Root-only: record the mesh shape and every leaf's PartitionSpec at
+        save time, then prune sidecars whose step Orbax no longer keeps.
+        Best-effort — a failed sidecar write degrades restore to
+        template/policy mode, never fails the save."""
+        import json
+
+        import jax
+        from jax.sharding import NamedSharding
+
+        if jax.process_index() != 0:
+            return
+        from .parallel import mesh as mesh_lib
+
+        try:
+            specs: dict[str, list] = {}
+            mesh_shape: dict[str, int] = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+                sharding = getattr(leaf, "sharding", None)
+                if not isinstance(sharding, NamedSharding):
+                    continue
+                specs[mesh_lib.path_str(path)] = mesh_lib.spec_to_jsonable(sharding.spec)
+                if not mesh_shape:
+                    mesh_shape = {str(k): int(v) for k, v in sharding.mesh.shape.items()}
+            record = {"v": 1, "mesh": mesh_shape, "specs": specs}
+            target = self._sharding_sidecar_file(scope, step)
+            target.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_text(target, json.dumps(record))
+            kept = set(int(s) for s in self.state_manager(scope).all_steps()) | {int(step)}
+            for f in target.parent.glob("*.json"):
+                if f.stem.isdigit() and int(f.stem) not in kept:
+                    f.unlink(missing_ok=True)
+        except Exception:
+            _logger.warning(
+                "could not write sharding sidecar for scope %r step %d "
+                "(resharded restore will need an explicit template/policy)",
+                scope, step, exc_info=True,
+            )
+
+    def read_sharding_sidecar(self, scope: str | None, step: int) -> dict | None:
+        """The save-time sharding record for ``step`` (``{"mesh": {axis:
+        size}, "specs": {leaf-path: spec}}``), or None when absent/corrupt."""
+        import json
+
+        try:
+            raw = json.loads(self._sharding_sidecar_file(scope, step).read_text())
+            if raw.get("v") == 1 and isinstance(raw.get("specs"), dict):
+                return raw
+        except Exception:
+            pass
+        return None
+
+    def restore_template(
+        self, step: int, scope: str | None = None, mesh: Any = None, policy: Any = None
+    ) -> Any:
+        """Build the abstract restore template for ``step`` targeted at
+        ``mesh`` — WITHOUT the caller hand-building the state pytree. Tree
+        structure, shapes, and dtypes come from Orbax's own checkpoint
+        metadata; each leaf's sharding is the save-time PartitionSpec
+        (sharding sidecar) re-targeted onto ``mesh`` via
+        :func:`parallel.mesh.respec_for_mesh` — axes the new mesh lacks
+        restore replicated, axes that stopped dividing relocate or drop.
+        Without a sidecar (pre-elastic checkpoints), ``policy`` (a
+        ``make_param_policy`` accepted value; default ``'replicate'``)
+        decides the layout instead."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .parallel import mesh as mesh_lib
+
+        if mesh is None:
+            raise ValueError("restore_template needs the target mesh")
+        meta = self.state_manager(scope).item_metadata(step)
+        if meta is None:
+            raise ValueError(f"no checkpoint metadata for step {step} (scope {scope!r})")
+        sidecar = self.read_sharding_sidecar(scope, step)
+        specs = (sidecar or {}).get("specs", {})
+        if sidecar is None:
+            _logger.warning(
+                "no sharding sidecar for scope %r step %d (checkpoint predates "
+                "elastic resume?); restoring with policy %r",
+                scope, step, policy or "replicate",
+            )
+        policy_fn = mesh_lib.make_param_policy(policy or "replicate")
+
+        def leaf(path, m):
+            p = mesh_lib.path_str(path)
+            shape = tuple(m.shape)
+            if p in specs:
+                spec = mesh_lib.respec_for_mesh(
+                    mesh_lib.spec_from_jsonable(specs[p]), shape, mesh
+                )
+            elif sidecar is not None:
+                spec = PartitionSpec()  # saved unsharded (or spec unrecorded)
+            else:
+                spec = policy_fn(p, m, mesh)
+            return jax.ShapeDtypeStruct(shape, m.dtype, sharding=NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(leaf, meta)
 
     # -- host-side retention (old orbax; utils/orbax_compat.py) -------------
     def _policy_metrics_file(self, scope: str | None) -> epath.Path:
@@ -330,9 +535,31 @@ class CheckpointDir:
             meta_file.parent.mkdir(parents=True, exist_ok=True)
             atomic_write_text(meta_file, json.dumps({str(k): v for k, v in known.items()}))
 
-    def restore_state(self, step: int | None = None, template: Any = None, scope: str | None = None) -> Any:
-        """Restore the latest (or a given) step; with ``template``, arrays are
-        restored with the template's shardings/dtypes."""
+    def restore_state(
+        self,
+        step: int | None = None,
+        template: Any = None,
+        scope: str | None = None,
+        *,
+        mesh: Any = None,
+        policy: Any = None,
+    ) -> Any:
+        """Restore the latest (or a given) step.
+
+        Three modes, most- to least-specified:
+
+        - ``template=``: arrays restore with the template's exact
+          shardings/dtypes (a template on a DIFFERENT mesh than the save is
+          fine — Orbax reshards on read; this is how stages resume).
+        - ``mesh=`` (no template): **elastic resharded restore** — the
+          template is rebuilt from the checkpoint's own metadata plus the
+          save-time sharding sidecar, re-targeted at ``mesh``
+          (:meth:`restore_template`), so a save taken on N devices restores
+          onto M devices without the caller knowing the state's structure.
+          ``policy`` covers sidecar-less checkpoints.
+        - neither: host numpy arrays with the SAVED shardings' layout —
+          wrong on any other mesh (lint rule DML207 flags this pattern in
+          mesh-building code)."""
         import orbax.checkpoint as ocp
 
         mgr = self.state_manager(scope)
@@ -340,6 +567,16 @@ class CheckpointDir:
             step = mgr.latest_step()
         if step is None:
             return None
+        if template is None and mesh is not None:
+            import jax
+
+            template = self.restore_template(step, scope=scope, mesh=mesh, policy=policy)
+            restored = mgr.restore(step, args=ocp.args.StandardRestore(template))
+            # Orbax may hand abstract-template restores back in host memory
+            # (memory_kind=unpinned_host); re-place on the mesh's default
+            # memory so the arrays are ready for the next compiled step.
+            shardings = jax.tree_util.tree_map(lambda t: t.sharding, template)
+            return jax.device_put(restored, shardings)
         if template is not None:
             return mgr.restore(step, args=ocp.args.StandardRestore(template))
         return mgr.restore(step)

@@ -77,6 +77,12 @@ class DataPipeline(_DatasetBase):
         #: drives epochs through this pipeline" (leave wrapped datasets'
         #: own epoch state alone) from an explicit epoch 0.
         self.epoch: int | None = None
+        #: elements this pipeline's CURRENT pass has yielded — the cursor
+        #: ``state_dict`` checkpoints (reset at each ``__iter__``)
+        self._consumed = 0
+        #: one-shot fast-forward applied by the next ``__iter__`` (set by
+        #: ``load_state_dict``)
+        self._pending_skip = 0
 
     # -- protocol -----------------------------------------------------------
     def set_epoch(self, epoch: int) -> None:
@@ -85,7 +91,65 @@ class DataPipeline(_DatasetBase):
         self.epoch = epoch
 
     def __iter__(self) -> Iterator:
-        return self._make_iter(self.epoch)
+        return self._tracked(self._make_iter(self.epoch))
+
+    def _tracked(self, it: Iterator) -> Iterator:
+        """Count yields (the resumable cursor) and apply a pending
+        fast-forward. The skip REPLAYS the upstream chain and discards —
+        every stateful stage (shuffle reservoirs, pack/interleave buffers,
+        per-epoch RNG) re-derives its exact state deterministically, so the
+        elements after the skip are bit-identical to an uninterrupted pass."""
+        self._consumed = 0
+        skip = self._pending_skip
+        self._pending_skip = 0
+        if skip:
+            import itertools
+
+            for _ in itertools.islice(it, skip):
+                pass
+            self._consumed = skip
+        for x in it:
+            self._consumed += 1
+            yield x
+
+    # -- resumable iteration state (elastic resume; doc/elasticity.md) ------
+    def state_dict(self) -> dict:
+        """Checkpointable iteration state: the epoch and the GLOBAL element
+        offset (``local consumed x world_size`` — every rank consumes in
+        lockstep, so the globally-consumed prefix is world-size-independent).
+        Save it alongside the model (the stage's step-save sidecar does this
+        automatically) and feed it to :meth:`load_state_dict` on resume —
+        including a resume on a DIFFERENT world size, where the per-rank
+        skip is re-derived from the global offset."""
+        ws = runtime.world_size()
+        return {
+            "v": 1,
+            "epoch": self.epoch,
+            "global_offset": int(self._consumed) * ws,
+            "world_size": ws,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore :meth:`state_dict` output: re-seeds the epoch and arms a
+        fast-forward so the next pass resumes at the exact next element. A
+        global offset not divisible by the new world size cannot be resumed
+        exactly (the remainder straddles ranks) — the skip rounds DOWN and
+        warns, replaying at most ``world_size - 1`` global elements."""
+        if not isinstance(state, dict) or state.get("v") != 1:
+            raise ValueError(f"unrecognised DataPipeline state: {state!r}")
+        if state.get("epoch") is not None:
+            self.set_epoch(int(state["epoch"]))
+        ws = runtime.world_size()
+        skip, rem = divmod(int(state["global_offset"]), ws)
+        if rem:
+            import logging
+
+            logging.getLogger("dmlcloud_tpu").warning(
+                "DataPipeline resume: global offset %d is not divisible by the new "
+                "world size %d; rounding down (up to %d element(s) replay)",
+                state["global_offset"], ws, ws - 1,
+            )
+        self._pending_skip = skip
 
     def __len__(self) -> int:
         if self._length_fn is None:
@@ -312,7 +376,10 @@ def _prefetch_iter(src: Iterator, num_elements: int) -> Iterator:
             return
         put(_END)
 
-    thread = threading.Thread(target=produce, daemon=True)
+    # named so shutdown tests (and a forensics dump's thread list) can
+    # identify host-prefetch threads; daemon so a full queue can never pin
+    # process exit even if the consumer leaks the generator
+    thread = threading.Thread(target=produce, daemon=True, name="dml-host-prefetch")
     thread.start()
     try:
         while True:

@@ -75,27 +75,39 @@ def device_iterator(
     else:
         src = iter(it)
 
-    if prefetch <= 0:
-        # strictly synchronous: one transfer per consumed batch, nothing
-        # pulled from the source (or put on device) ahead of the step
-        for batch in src:
-            with _journal.span("h2d", prefetch=0):
-                yield_batch = mesh_lib.make_global_batch(batch, mesh, pspec)
-            yield yield_batch
-        return
+    # Shutdown hardening (the preemption drain path): a consumer that
+    # abandons this iterator mid-epoch — a break out of the step loop, a
+    # generator .close(), GC — must tear down the host-prefetch machinery
+    # PROMPTLY. Closing ``src`` here runs _prefetch_iter's finally (stop
+    # event + queue drain), so its background thread exits within one put
+    # timeout instead of lingering blocked on a full queue until interpreter
+    # exit. Without host_prefetch the close is a harmless no-op/absent.
+    try:
+        if prefetch <= 0:
+            # strictly synchronous: one transfer per consumed batch, nothing
+            # pulled from the source (or put on device) ahead of the step
+            for batch in src:
+                with _journal.span("h2d", prefetch=0):
+                    yield_batch = mesh_lib.make_global_batch(batch, mesh, pspec)
+                yield yield_batch
+            return
 
-    def enqueue(n: int) -> None:
-        for _ in range(n):
-            try:
-                batch = next(src)
-            except StopIteration:
-                return
-            # the span covers the host-side put dispatch only — the copy
-            # itself is async and overlaps compute (that's the point)
-            with _journal.span("h2d", prefetch=prefetch):
-                queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
+        def enqueue(n: int) -> None:
+            for _ in range(n):
+                try:
+                    batch = next(src)
+                except StopIteration:
+                    return
+                # the span covers the host-side put dispatch only — the copy
+                # itself is async and overlaps compute (that's the point)
+                with _journal.span("h2d", prefetch=prefetch):
+                    queue.append(mesh_lib.make_global_batch(batch, mesh, pspec))
 
-    enqueue(prefetch)
-    while queue:
-        yield queue.popleft()
-        enqueue(1)
+        enqueue(prefetch)
+        while queue:
+            yield queue.popleft()
+            enqueue(1)
+    finally:
+        close = getattr(src, "close", None)
+        if close is not None:
+            close()

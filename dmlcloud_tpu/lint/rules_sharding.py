@@ -1,4 +1,4 @@
-"""The sharding/collective contract rules (DML201-DML204).
+"""The sharding/collective contract rules (DML201-DML204, DML207).
 
 GSPMD-style named-axis sharding makes axis names and partition specs the
 load-bearing strings of a pjit program: a typo'd ``axis_name``, a
@@ -16,10 +16,13 @@ axis names through assignments and across files:
           outside any ``shard_map``/``jit`` trace context
 - DML204  value donated to a jitted call (``donate_argnums``) read again
           after the call — the buffer no longer exists
+- DML207  ``restore_state()`` without a ``template=``/``mesh=`` target in
+          code that builds a mesh — the restore silently keeps the
+          SAVE-time layout, wrong on the mesh built here
 
-All four stay silent when a value cannot be *proven* (an axis name that is
-a function parameter, specs built dynamically): a linter that guesses is a
-linter that gets disabled.
+All of them stay silent when a value cannot be *proven* (an axis name that
+is a function parameter, specs built dynamically): a linter that guesses is
+a linter that gets disabled.
 """
 
 from __future__ import annotations
@@ -453,3 +456,74 @@ def check_use_after_donate(ctx: ModuleCtx):
                         "call's result instead, or drop the donation",
                         getattr(fn, "name", ""),
                     )
+
+
+# ------------------------------------------------------------------- DML207
+
+
+def _builds_mesh(ctx: ModuleCtx, container: ast.AST) -> bool:
+    """Whether any call under ``container`` provably resolves to a mesh
+    builder (``create_mesh``/``auto_mesh``/``set_mesh``/``Mesh``/
+    ``parse_mesh_axes``) — the dataflow core's notion of mesh-declaring
+    code, reused as DML207's notion of mesh-BUILDING code."""
+    for node in ast.walk(container):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func) or ""
+        last = resolved.split(".")[-1] if resolved else ""
+        if not last and isinstance(node.func, ast.Attribute):
+            last = node.func.attr
+        if last in dataflow._MESH_BUILDERS:
+            return True
+    return False
+
+
+@rule("DML207", "restore_state without a template/mesh target in mesh-building code")
+def check_untargeted_restore(ctx: ModuleCtx):
+    """``ckpt.restore_state()`` with neither ``template=`` nor ``mesh=``
+    hands back arrays in the SAVE-time layout. In code that builds its own
+    mesh that is almost never what runs next: the restored state silently
+    mismatches the mesh built here, compiles fine on CPU, and fails (or
+    silently double-pays resharding) only on the TPU pod. Flow-aware: a
+    ``template`` argument that provably resolves to ``None`` (``tpl = None;
+    ckpt.restore_state(1, tpl)``) counts as absent, an unresolvable one is
+    trusted; code whose enclosing function (or, at module level, module)
+    never provably builds a mesh stays silent — a helper restoring for
+    host-side analysis is legitimate. Fix: pass ``mesh=<the mesh built
+    here>`` for the elastic resharded restore (doc/elasticity.md), or an
+    explicit template."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name != "restore_state":
+            continue
+        scopes = ctx.scopes_at(node)
+        target_expr = node.args[1] if len(node.args) > 1 else None
+        targeted = False
+        for kw in node.keywords:
+            if kw.arg in ("template", "mesh"):
+                target_expr = kw.value
+            elif kw.arg is None:
+                targeted = True  # **kwargs: cannot prove the target absent
+        if target_expr is not None:
+            resolved = dataflow.resolve_expr(target_expr, scopes)
+            if not (isinstance(resolved, ast.Constant) and resolved.value is None):
+                targeted = True
+        if targeted:
+            continue
+        fn = ctx.enclosing_function(node)
+        if not _builds_mesh(ctx, fn if fn is not None else ctx.tree):
+            continue
+        yield _f(
+            ctx, "DML207", node,
+            "restore_state() without template= or mesh= in code that builds "
+            "a mesh: the restore keeps the SAVE-time sharding layout, which "
+            "silently mismatches the mesh built here and fails only on the "
+            "TPU — pass mesh=<the current mesh> (resharded restore) or an "
+            "explicit template",
+            _fn_context_name(ctx, node),
+        )

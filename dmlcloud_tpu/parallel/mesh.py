@@ -172,6 +172,58 @@ def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs):
     )
 
 
+def respec_for_mesh(spec: P | Sequence, shape: Sequence[int], mesh: Mesh) -> P:
+    """Re-target a PartitionSpec recorded on ONE mesh onto ``mesh`` — the
+    elastic-resume primitive: a checkpoint saved on an N-device mesh carries
+    each leaf's spec, and the resumed run rebuilds shardings for whatever
+    mesh it actually got. Axes the new mesh lacks are dropped (replicated);
+    axes that no longer divide their dim (the axis grew, e.g. fsdp 2 -> 8 on
+    a dim of 4) are relocated to another divisible dim when one exists, else
+    dropped with a warning. Always returns a spec valid on ``mesh``."""
+    entries = list(spec) if spec is not None else []
+    shape = tuple(shape)
+    cleaned: list = [None] * len(shape)
+    displaced: list = []
+    for i, a in enumerate(entries[: len(shape)]):
+        axes = (a,) if isinstance(a, str) else (a or ())
+        if a is None or not axes or not all(x in mesh.axis_names for x in axes):
+            continue
+        n = math.prod(mesh.shape[x] for x in axes)
+        if shape[i] % n == 0:
+            cleaned[i] = a
+        else:
+            displaced.append((a, n))
+    for a, n in displaced:
+        for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if cleaned[i] is None and shape[i] % n == 0 and shape[i] >= 2 * n:
+                cleaned[i] = a
+                break
+        else:
+            _logger.warning(
+                "restore respec: no dim of shape %s divisible by saved axis %r "
+                "(size %d on the new mesh); restoring that axis replicated",
+                shape, a, n,
+            )
+    return P(*cleaned)
+
+
+def spec_to_jsonable(spec: P | None) -> list:
+    """A PartitionSpec as a JSON-serialisable list (None | str | [str, ...]
+    per dim) — the sharding-sidecar wire format (checkpoint.py)."""
+    out: list = []
+    for a in (spec or ()):
+        if a is None or isinstance(a, str):
+            out.append(a)
+        else:
+            out.append(list(a))
+    return out
+
+
+def spec_from_jsonable(entries: Sequence) -> P:
+    """Inverse of :func:`spec_to_jsonable`."""
+    return P(*[tuple(a) if isinstance(a, list) else a for a in (entries or ())])
+
+
 # ---------------------------------------------------------------------------
 # parameter sharding policies
 # ---------------------------------------------------------------------------

@@ -620,6 +620,91 @@ def gather_object(
     return _get_objs("gather", seq, timeout, tag)
 
 
+# ---------------------------------------------------------------------------
+# preemption guard (elastic resume; doc/elasticity.md)
+# ---------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Signal-driven drain flag for preemption-tolerant training.
+
+    The scheduler's eviction warning (Cloud TPU: SIGTERM; Slurm:
+    ``--signal=USR1@60`` -> SIGUSR1; an operator's Ctrl-C: SIGINT) lands on
+    SOME rank as an async signal. The guard turns that into a clean,
+    coordinated drain: the handler only flips :attr:`triggered` (never logs
+    or raises — the signal may interrupt a buffered stream), and the step
+    loop polls :meth:`coordinated` at save boundaries so every rank agrees
+    to stop at the SAME step — a one-sided exit would strand the survivors
+    in the next collective.
+
+    ``install()`` resolves every signal name BEFORE touching any handler (a
+    typo'd name must not leave a half-installed set) and remembers the
+    original dispositions for :meth:`uninstall`. ``armed`` is separate from
+    installation so tests (and driver code that learns about preemption out
+    of band) can flip :attr:`triggered` directly.
+    """
+
+    #: default signal set: scheduler eviction + operator interrupt, plus the
+    #: Slurm warning signal when running inside a Slurm step
+    DEFAULT_SIGNALS = ("SIGTERM", "SIGINT")
+
+    def __init__(self, signals: tuple[str, ...] | None = None):
+        if signals is None:
+            signals = self.DEFAULT_SIGNALS
+            if _slurm.slurm_available():
+                signals = signals + ("SIGUSR1",)
+        self.signals = tuple(signals)
+        #: set (async) by the signal handler; cleared by install()
+        self.triggered = False
+        #: the signal name that tripped the guard, for the requeue verdict
+        self.signal_name: str | None = None
+        #: whether coordinated() participates in the cross-rank gather
+        self.armed = False
+        self._prev: dict = {}
+
+    def install(self) -> "PreemptionGuard":
+        import signal as _signal
+
+        sigs = [getattr(_signal, name) for name in self.signals]
+        for sig in sigs:
+            prev = _signal.signal(sig, self._handler)
+            # re-install on the same signal keeps the ORIGINAL disposition
+            self._prev.setdefault(sig, prev)
+        self.triggered = False
+        self.signal_name = None
+        self.armed = True
+        return self
+
+    def _handler(self, signum, frame):
+        # flag only — the normal control path reports the drain
+        self.triggered = True
+        try:
+            import signal as _signal
+
+            self.signal_name = _signal.Signals(signum).name
+        except Exception:  # pragma: no cover - exotic signum
+            self.signal_name = str(signum)
+
+    def uninstall(self) -> None:
+        """Restore the original process-wide dispositions (a stale handler
+        would make post-run SIGTERM a silent no-op)."""
+        if self._prev:
+            import signal as _signal
+
+            for sig, prev in self._prev.items():
+                _signal.signal(sig, prev)
+            self._prev = {}
+        self.armed = False
+
+    def coordinated(self) -> bool:
+        """Whether ANY rank caught a preemption signal — ranks must agree on
+        stopping or the survivors deadlock in the next collective."""
+        if not self.armed:
+            return False
+        if world_size() <= 1:
+            return self.triggered
+        return any(all_gather_object(self.triggered, tag="preemption-drain"))
+
+
 def all_gather_array(x) -> np.ndarray:
     """Gather one same-shape numeric array from every process as
     ``[world, *x.shape]`` via ONE XLA collective over ICI/DCN — the fast path

@@ -148,9 +148,9 @@ class TrainingPipeline:
         self._tensorboard_dir: str | None = None
         self._tb_writer = None
 
-        self._preempted = False
-        self._preemption_enabled = False
-        self._prev_signal_handlers: dict = {}
+        self._preemption = runtime.PreemptionGuard(signals=())
+        self._verdict_written = False
+        self._verdict_kind: Optional[str] = None
 
         self.stages: list[Stage] = []
         self.datasets: dict[str, Any] = {}
@@ -440,43 +440,100 @@ class TrainingPipeline:
         runtime.barrier("pipeline", timeout if timeout is not None else 600.0)
 
     # -------------------------------------------------------- preemption
-    def enable_preemption_handling(self, signals: tuple[str, ...] = ("SIGTERM",)):
-        """Exit cleanly at the next epoch boundary when any of ``signals``
+    #: back-compat views over the PreemptionGuard (parallel/runtime.py),
+    #: which owns the signal handlers and the cross-rank drain decision
+    @property
+    def _preempted(self) -> bool:
+        return self._preemption.triggered
+
+    @_preempted.setter
+    def _preempted(self, v: bool) -> None:
+        self._preemption.triggered = bool(v)
+
+    @property
+    def _preemption_enabled(self) -> bool:
+        return self._preemption.armed
+
+    @_preemption_enabled.setter
+    def _preemption_enabled(self, v: bool) -> None:
+        self._preemption.armed = bool(v)
+
+    @property
+    def _prev_signal_handlers(self) -> dict:
+        return self._preemption._prev
+
+    def enable_preemption_handling(self, signals: tuple[str, ...] | None = ("SIGTERM",)):
+        """Exit cleanly at the next save boundary when any of ``signals``
         arrives on ANY rank (Cloud TPU preemption sends SIGTERM; Slurm jobs
-        typically arrange ``--signal=USR1@60`` -> pass ``("SIGUSR1",)``).
+        typically arrange ``--signal=USR1@60`` -> pass ``("SIGUSR1",)``, or
+        pass ``signals=None`` for the guard's environment-aware default:
+        SIGTERM + SIGINT, plus SIGUSR1 inside a Slurm step).
 
-        The epoch that just finished has already auto-saved its checkpoint,
-        and the stage is NOT marked stopped — so a requeued/restarted run
-        resumes at the next epoch instead of terminating for good. This is
-        TPU-side scope: the reference's fault model is Slurm requeue after
-        the fact (reference checkpoint.py:37-48) with no in-flight signal
-        handling."""
-        import signal as _signal
-
-        def handler(signum, frame):
-            # flag only — logging here could re-enter a buffered stream the
-            # signal interrupted; the normal control path reports the exit
-            self._preempted = True
-
-        # resolve every name BEFORE installing anything: a typo'd or
-        # platform-unsupported name must not leave a half-installed set
-        sigs = [getattr(_signal, name) for name in signals]
-        for sig in sigs:
-            prev = _signal.signal(sig, handler)
-            # re-enable on the same signal keeps the ORIGINAL disposition
-            # for _teardown, never our own closure
-            self._prev_signal_handlers.setdefault(sig, prev)
-        self._preempted = False  # a fresh arming forgets any earlier run's flag
-        self._preemption_enabled = True
+        With epoch checkpointing the drain lands at the epoch boundary
+        (the finished epoch has already auto-saved); with
+        ``checkpoint_every_steps()`` armed it lands at the next step-save
+        boundary mid-epoch. Either way the stage is NOT marked stopped and
+        the root writes a requeue verdict (``requeue.json``,
+        doc/elasticity.md) so a requeued run resumes where this one drained
+        — on whatever mesh the new allocation provides (resharded restore).
+        This is TPU-side scope: the reference's fault model is Slurm
+        requeue after the fact (reference checkpoint.py:37-48) with no
+        in-flight signal handling."""
+        # re-arming: restore the ORIGINAL dispositions first, so the new
+        # guard's install records them (not our previous handler) as prev
+        self._preemption.uninstall()
+        self._preemption = runtime.PreemptionGuard(signals=signals).install()
 
     def _preemption_coordinated(self) -> bool:
-        """Whether ANY rank caught a preemption signal — ranks must agree on
-        stopping or the survivors deadlock in the next collective."""
-        if not self._preemption_enabled:
-            return False
-        if runtime.world_size() <= 1:
-            return self._preempted
-        return any(runtime.all_gather_object(self._preempted))
+        """Whether ANY rank caught a preemption signal (see
+        ``PreemptionGuard.coordinated``)."""
+        return self._preemption.coordinated()
+
+    def _write_requeue_verdict(
+        self, requeue: bool, kind: str, reason: str, force: bool = False, **extra
+    ) -> None:
+        """Root-only, first-writer-wins requeue verdict for this run (the
+        preemption/hang verdict must not be stomped by the teardown's
+        generic classification; ``force`` is for the one legitimate
+        supersession — a run that RECOVERED from a watchdog-flagged stall
+        and completed). No-op without a checkpoint dir — there is nowhere
+        durable to resume from, so a verdict would be noise."""
+        if (self._verdict_written and not force) or self.checkpoint_dir is None or not runtime.is_root():
+            return
+        from .checkpoint import is_remote_path, write_requeue_verdict
+
+        try:
+            if not is_remote_path(self.checkpoint_dir.path) and not self.checkpoint_dir.exists:
+                return  # e.g. run failed before _init_checkpointing created it
+            write_requeue_verdict(self.checkpoint_dir.path, requeue, reason, kind, **extra)
+            self._verdict_written = True
+            self._verdict_kind = kind
+            self.logger.info(
+                "requeue verdict: requeue=%s (%s) — %s", requeue, kind, reason
+            )
+        except Exception:
+            self.logger.warning("could not write requeue verdict", exc_info=True)
+
+    def _classify_failure(self, exc: BaseException) -> tuple[bool, str, str]:
+        """(requeue, kind, reason) for an uncaught exception — the automated
+        half of the flight recorder's post-mortem: deterministic failures
+        (NaN loss, lint errors) must NOT be requeued (they recur), while
+        transient infrastructure failures (stragglers/hangs, filesystem
+        errors) should be."""
+        if isinstance(exc, KeyboardInterrupt):
+            return False, "user-interrupt", "run aborted by user (KeyboardInterrupt)"
+        if isinstance(exc, runtime.BarrierTimeout):
+            return True, "hang", (
+                f"barrier '{exc.tag}' timed out; straggler ranks {exc.stragglers or 'unknown'}"
+                " — transient by default, forensics dumped"
+            )
+        if isinstance(exc, FloatingPointError):
+            return False, "exception", f"non-finite loss is deterministic: {exc}"
+        if isinstance(exc, OSError):
+            return True, "exception", (
+                f"filesystem/IO error ({type(exc).__name__}: {exc}) — transient by default"
+            )
+        return False, "exception", f"{type(exc).__name__}: {exc}"
 
     # ------------------------------------------------------------ lifecycle
     def run(self):
@@ -490,6 +547,20 @@ class TrainingPipeline:
                 # across ranks, no extra collective needed here
                 if getattr(stage, "_preempt_exit", False):
                     self.logger.info("preemption requested; skipping remaining stages")
+                    extra = {
+                        "stage": stage.name,
+                        "epoch": stage.current_epoch,
+                        "mid_epoch": bool(getattr(stage, "_mid_epoch_exit", False)),
+                    }
+                    lat = getattr(stage, "_last_save_latency_s", None)
+                    if lat is not None:
+                        extra["save_on_preempt_latency_s"] = round(float(lat), 4)
+                    sig = self._preemption.signal_name or "coordinated-drain"
+                    self._write_requeue_verdict(
+                        True, "preemption",
+                        f"drained cleanly on {sig}; state saved at the last boundary, resumable",
+                        **extra,
+                    )
                     break
             self._post_run()
 
@@ -553,6 +624,8 @@ class TrainingPipeline:
     def _pre_run(self):
         if len(self.stages) == 0:
             raise ValueError("No stages defined. Use append_stage() to add stages to the pipeline.")
+        self._verdict_written = False
+        self._verdict_kind = None
         self._lint_stages()
         if self._compile_cache not in (None, False):
             # before ANY compilation (incl. the collectives the runtime
@@ -657,6 +730,18 @@ class TrainingPipeline:
             journal=self._journal,
         )
         self._journal.on_emit = self._watchdog.notify
+
+        def _hang_verdict(reason: str) -> None:
+            # the forensics dump's requeue-wrapper counterpart: a hang is
+            # transient by default (requeue and let the watchdog's evidence
+            # drive a deeper look), and the verdict names the stragglers
+            extra = {}
+            stragglers = runtime.barrier_state().get("stragglers")
+            if stragglers:
+                extra["stragglers"] = stragglers
+            self._write_requeue_verdict(True, "hang", reason, **extra)
+
+        self._watchdog.on_dump = _hang_verdict
         self._watchdog.start()
         self._run_span_t0 = journal_mod.now()
         if runtime.is_root():
@@ -740,6 +825,13 @@ class TrainingPipeline:
         self.logger.info(f"Finished training in {self.stop_time - self.start_time} ({self.stop_time})")
         if self.checkpointing_enabled:
             self.logger.info(f"Outputs have been saved to {self.checkpoint_dir}")
+        # a run that got here without a preemption verdict finished for real:
+        # tell the requeue wrapper to stand down. A survived watchdog stall
+        # is the one verdict completion supersedes (the run recovered).
+        self._write_requeue_verdict(
+            False, "completed", "run finished all stages",
+            force=(self._verdict_kind == "hang"),
+        )
         self.post_run()
 
     def _pre_epoch(self):
@@ -763,6 +855,11 @@ class TrainingPipeline:
             self.logger.info("=== run aborted by user (KeyboardInterrupt) ===")
         elif exc is not None:
             self.logger.error("=== run failed; traceback follows ===", exc_info=exc)
+        if exc is not None:
+            # the failure's requeue verdict (first-writer-wins: a preemption
+            # or hang verdict already written this run is not stomped)
+            requeue, kind, reason = self._classify_failure(exc)
+            self._write_requeue_verdict(requeue, kind, reason)
         try:
             self._disarm_telemetry(exc)
         except Exception:
@@ -783,15 +880,9 @@ class TrainingPipeline:
             self._tb_writer = None
         if self.io_redirector is not None:
             self.io_redirector.uninstall()
-        if self._prev_signal_handlers:
-            # restore process-wide dispositions: a stale handler would make
-            # post-run SIGTERM a silent no-op and pin this pipeline alive
-            import signal as _signal
-
-            for sig, prev in self._prev_signal_handlers.items():
-                _signal.signal(sig, prev)
-            self._prev_signal_handlers = {}
-            self._preemption_enabled = False
+        # restore process-wide signal dispositions: a stale handler would
+        # make post-run SIGTERM a silent no-op and pin this pipeline alive
+        self._preemption.uninstall()
 
 
 @contextmanager

@@ -324,8 +324,15 @@ class TrainValStage(Stage):
         self._train_step_fn = None
         self._val_step_fn = None
         #: batches of the CURRENT epoch to skip on a mid-epoch resume
-        #: (one-shot, set by _restore_state from a step-save sidecar)
+        #: (one-shot, set by _restore_state from a step-save sidecar,
+        #: already scaled to THIS run's world size)
         self._resume_skip_steps = 0
+        #: the train DataPipeline's saved iterator state, when the sidecar
+        #: carries one (one-shot; preferred over the raw batch skip)
+        self._resume_data_state = None
+        #: wall-clock of the most recent state save — the preemption
+        #: verdict's save-on-preempt latency
+        self._last_save_latency_s: float | None = None
         #: set when a preemption poll at a step-save point cut the epoch
         #: short: run_epoch skips val and Stage.run exits without treating
         #: the partial epoch as complete
@@ -1045,9 +1052,11 @@ class TrainValStage(Stage):
         # is waited out (timed as stall) before the new one dispatches. The
         # save call itself is timed too — async it costs one D2H snapshot,
         # sync (async_checkpoint() False) it blocks for the full commit.
+        t0 = time.perf_counter()
         with self._stall.measure(label="checkpoint"):
             ckpt.wait_until_finished(scope=self.name)
             ckpt.save_state(completed, self._state_pytree(), scope=self.name, **save_kwargs)
+        self._last_save_latency_s = time.perf_counter() - t0
         if is_root():
             from .utils.serialization import to_jsonable
 
@@ -1096,8 +1105,12 @@ class TrainValStage(Stage):
     def _save_step_state(self, epoch_step: int) -> None:
         """Collective mid-epoch save keyed by the GLOBAL optimizer step, with
         a root-written sidecar recording where inside which epoch it landed
-        (what a resume needs to fast-forward the data)."""
+        (what a resume needs to fast-forward the data), under which world
+        size (so a resume on a DIFFERENT process count re-derives its
+        per-rank position), and — when the train dataset is resumable — its
+        iterator state."""
         ckpt = self.pipeline.checkpoint_dir
+        t0 = time.perf_counter()
         with self._stall.measure(label="checkpoint"):
             # at most one save in flight; the step-counter fetch blocks on
             # the dispatched steps, so both waits count as host stall — as
@@ -1106,12 +1119,24 @@ class TrainValStage(Stage):
             ckpt.wait_until_finished(scope=self._steps_scope)
             gstep = int(jax.device_get(self.state.step))
             ckpt.save_state(gstep, self._state_pytree(), scope=self._steps_scope)
+        #: the preemption verdict's save-on-preempt latency (doc/elasticity.md)
+        self._last_save_latency_s = time.perf_counter() - t0
         if is_root():
-            self._write_resume_sidecar(
-                self._steps_scope,
-                gstep,
-                {"epoch": self.current_epoch, "step_in_epoch": epoch_step},
-            )
+            payload = {
+                "epoch": self.current_epoch,
+                "step_in_epoch": epoch_step,
+                "world_size": runtime.world_size(),
+            }
+            ds = self.pipeline.datasets.get("train")
+            if hasattr(ds, "state_dict"):
+                try:
+                    payload["data"] = ds.state_dict()
+                except Exception:
+                    self.logger.warning(
+                        "train dataset state_dict() failed; resume will fast-forward "
+                        "by batch count instead", exc_info=True,
+                    )
+            self._write_resume_sidecar(self._steps_scope, gstep, payload)
 
     def _read_step_resume_meta(self, gstep: int) -> dict | None:
         """Root-only: the step-save sidecar, or None (degrade to epoch resume)."""
@@ -1120,7 +1145,12 @@ class TrainValStage(Stage):
         meta_file = self.pipeline.checkpoint_dir.path / "meta" / self._steps_scope / f"{gstep}.json"
         try:
             raw = json.loads(meta_file.read_text())
-            return {"epoch": int(raw["epoch"]), "step_in_epoch": int(raw["step_in_epoch"])}
+            meta = {"epoch": int(raw["epoch"]), "step_in_epoch": int(raw["step_in_epoch"])}
+            # optional elastic fields (absent in pre-elastic sidecars)
+            meta["world_size"] = int(raw.get("world_size", runtime.world_size()))
+            if isinstance(raw.get("data"), dict):
+                meta["data"] = raw["data"]
+            return meta
         except Exception:
             self.logger.warning(
                 f"No usable step-resume metadata at {meta_file}; falling back (last "
@@ -1266,7 +1296,21 @@ class TrainValStage(Stage):
             self.current_epoch = latest + 1
         if step_meta is not None:
             self.current_epoch = step_meta["epoch"]
-            self._resume_skip_steps = step_meta["step_in_epoch"]
+            # elastic world-size scaling: the sidecar's batch count is
+            # per-rank UNDER THE SAVED world size; re-derive this run's
+            # per-rank skip from the world-size-independent global count
+            saved_ws = int(step_meta.get("world_size", runtime.world_size()))
+            ws = runtime.world_size()
+            global_batches = step_meta["step_in_epoch"] * saved_ws
+            skip, rem = divmod(global_batches, ws)
+            if rem:
+                self.logger.warning(
+                    f"mid-epoch resume: {global_batches} globally-consumed batches do "
+                    f"not divide the new world size {ws}; rounding down (up to "
+                    f"{ws - 1} global batch(es) replay)"
+                )
+            self._resume_skip_steps = skip
+            self._resume_data_state = step_meta.get("data")
             # sparse checkpoint_every (>1): the restored tracker may trail
             # the resumed epoch — pad the gap (None entries) so every later
             # epoch's metrics stay aligned with its epoch number
@@ -1275,6 +1319,7 @@ class TrainValStage(Stage):
                 f"Restored stage '{self.name}' from mid-epoch step save (global step "
                 f"{step_latest}); continuing epoch {self.current_epoch} at batch "
                 f"{self._resume_skip_steps}"
+                + (f" (resharded from world size {saved_ws})" if saved_ws != ws else "")
             )
         elif blind_step:
             self.logger.warning(
@@ -1340,16 +1385,23 @@ class TrainValStage(Stage):
         data_wait bucket (+ a journal span per batch). Only interposed when
         telemetry is armed — the default feeding path is untouched."""
         it = iter(self._feed(ds))
-        while True:
-            t0 = time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                return
-            t1 = time.perf_counter()
-            self._gp_data_wait_ns += int((t1 - t0) * 1e9)
-            _journal.emit("data_wait", t0, t1)
-            yield batch
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                t1 = time.perf_counter()
+                self._gp_data_wait_ns += int((t1 - t0) * 1e9)
+                _journal.emit("data_wait", t0, t1)
+                yield batch
+        finally:
+            # abandonment (preemption drain) must reach the device iterator's
+            # own shutdown path promptly, not wait for GC
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
 
     def _feed_for_epoch(self, ds):
         return self._timed_feed(ds) if self._telemetry_armed else self._feed(ds)
@@ -1366,10 +1418,22 @@ class TrainValStage(Stage):
 
         # mid-epoch resume: fast-forward the deterministic per-epoch
         # iteration past the batches the interrupted run already consumed
-        # (host-side skip — no device transfers for skipped batches)
+        # (host-side skip — no device transfers for skipped batches). A
+        # resumable dataset (DataPipeline.load_state_dict) fast-forwards
+        # itself from the saved iterator state — same elements, but the
+        # cursor survives world-size changes and future step saves keep
+        # checkpointing coherent offsets.
         skipped = self._resume_skip_steps
         self._resume_skip_steps = 0
-        if skipped:
+        data_state = self._resume_data_state
+        self._resume_data_state = None
+        if data_state is not None and hasattr(train_ds, "load_state_dict"):
+            train_ds.load_state_dict(data_state)
+            self.logger.info(
+                f"mid-epoch resume: train dataset fast-forwarded from saved iterator "
+                f"state {data_state} for epoch {self.current_epoch}"
+            )
+        elif skipped:
             import itertools
 
             train_ds = itertools.islice(iter(train_ds), skipped, None)
@@ -1409,8 +1473,9 @@ class TrainValStage(Stage):
 
         last_metrics = None
         self._in_step_loop = True
+        feed = self._feed_for_epoch(train_ds)
         try:
-            for batch in self._feed_for_epoch(train_ds):
+            for batch in feed:
                 step_start = time.perf_counter_ns()
                 self.state, metrics = self._train_step_fn(self.state, batch)
                 step_end = time.perf_counter_ns()
@@ -1479,6 +1544,12 @@ class TrainValStage(Stage):
                         last_render = now
         finally:
             self._in_step_loop = False
+            # deterministic feed shutdown: a break (mid-epoch preemption
+            # drain) must stop the prefetch machinery NOW — its background
+            # thread joins within one put timeout — not at GC time
+            close = getattr(feed, "close", None)
+            if close is not None:
+                close()
 
         # Close the async pipeline BEFORE the epoch wall-clock reading so the
         # per-step average below reflects device execution, then derive the

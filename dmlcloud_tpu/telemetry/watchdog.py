@@ -92,6 +92,10 @@ class HangWatchdog:
         self.interval_s = float(interval_s)
         self.journal = journal
         self.last_n_spans = int(last_n_spans)
+        #: optional ``fn(reason)`` called after every forensics dump — the
+        #: pipeline wires the requeue-verdict writer here so a hang leaves a
+        #: machine-readable requeue decision, not only the JSON post-mortem
+        self.on_dump: Callable[[str], None] | None = None
         self._clock = clock
         self._last = clock()
         self._dumped_this_stall = False
@@ -163,6 +167,11 @@ class HangWatchdog:
                 self.journal.flush()
             except Exception:
                 pass
+        if self.on_dump is not None:
+            try:
+                self.on_dump(reason)
+            except Exception:
+                logger.exception("watchdog on_dump hook failed")
         return path
 
     # -- thread lifecycle ------------------------------------------------------

@@ -215,6 +215,8 @@ def main():
     pipeline.set_mesh(axes)
     if args.checkpoint_dir:
         pipeline.enable_checkpointing(args.checkpoint_dir, resume=args.resume)
+        # elastic resume: drain-save-verdict on eviction (doc/elasticity.md)
+        pipeline.enable_preemption_handling(signals=None)
     stage = CLIPStage()
     pipeline.append_stage(stage, max_epochs=args.epochs)
     pipeline.run()

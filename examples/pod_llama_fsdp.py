@@ -205,6 +205,11 @@ def main():
     pipeline.set_mesh(axes)
     if args.checkpoint_dir:
         pipeline.enable_checkpointing(args.checkpoint_dir, resume=args.resume)
+        # elastic resume (doc/elasticity.md): scheduler eviction drains at
+        # the next step-save boundary, commits the state, writes the requeue
+        # verdict; the requeued run restores onto WHATEVER mesh it gets
+        # (signals=None = SIGTERM/SIGINT + SIGUSR1 under Slurm --signal)
+        pipeline.enable_preemption_handling(signals=None)
     stage = LlamaStage()
     pipeline.append_stage(stage, max_epochs=args.epochs)
     pipeline.run()

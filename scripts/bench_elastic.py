@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Elastic-resume receipt: the preemption drill as a benchmark
+(doc/elasticity.md). Trains on 4 fake CPU devices, delivers a REAL SIGTERM
+mid-epoch, drains at the next step-save boundary, resumes the SAME run dir
+on 2 devices, and reports:
+
+- ``save_on_preempt_latency_s``  the drain's final committed save
+- ``time_to_resume_s``           resume start -> first resumed step
+- ``steps_replayed``             0 on exact data-order resumption
+
+Thin CLI over ``bench.bench_elastic`` (which runs ``bench.py
+--elastic-child`` pinned to 4 CPU devices) so the committed receipt and an
+interactive investigation run the exact same drill. The receipt's flat
+``gate`` section is what ``bench.py --gate --suite elastic`` /
+scripts/perf_gate.sh compares.
+
+    JAX_PLATFORMS=cpu python scripts/bench_elastic.py --out BENCH_elastic_pr07.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    args = parser.parse_args()
+
+    from bench import bench_elastic
+
+    results = bench_elastic()
+    if results is None:
+        print("elastic drill failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

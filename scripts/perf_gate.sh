@@ -1,16 +1,26 @@
 #!/usr/bin/env bash
-# CI perf gate: the current kernel ratios (flash fwd / fwd+bwd vs unfused,
-# speculative speedup + accept rate, int8 decode) and goodput fraction must
-# not drop more than the tolerance below the last committed
-# BENCH_kernels_*.json receipt (doc/performance.md §"Kernel receipts").
+# CI perf gate, two suites (doc/performance.md §"Kernel receipts",
+# doc/elasticity.md):
+#
+#   kernels  current kernel ratios (flash fwd / fwd+bwd vs unfused,
+#            speculative speedup + accept rate, int8 decode) and goodput
+#            fraction vs the last committed BENCH_kernels_*.json
+#   elastic  the preemption drill (SIGTERM mid-epoch on 4 devices, resume
+#            on 2) vs the last committed BENCH_elastic_*.json — exact
+#            resume (0 replayed steps), save-on-preempt latency,
+#            time-to-resume; a missing metric FAILS
+#
 # Runs after the lint gate in the CI flow:
 #
 #     scripts/lint_gate.sh && scripts/perf_gate.sh
 #
-# Usage: scripts/perf_gate.sh [extra gate args, e.g. --tolerance 0.2
-#        --baseline BENCH_kernels_pr06.json --current fresh.json]
-# With no --current the gate measures fresh ratios in a CPU-pinned child
-# (a few minutes); exit 0 pass, 1 regression, 2 could-not-measure.
+# Usage: scripts/perf_gate.sh [extra gate args, e.g. --suite kernels
+#        --tolerance 0.2 --baseline BENCH_kernels_pr06.json --current f.json]
+# With no args BOTH suites run (each measures fresh in a CPU-pinned child —
+# a few minutes); exit 0 pass, 1 regression, 2 could-not-measure.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python bench.py --gate "$@"
+if [ "$#" -gt 0 ]; then
+    exec env JAX_PLATFORMS=cpu python bench.py --gate "$@"
+fi
+exec env JAX_PLATFORMS=cpu python bench.py --gate --suite all

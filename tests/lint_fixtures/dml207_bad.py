@@ -1,0 +1,33 @@
+"""DML207 bad fixture: restore_state() without a template/mesh target in
+code that builds a mesh.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+from jax.sharding import Mesh
+
+from dmlcloud_tpu.checkpoint import CheckpointDir
+from dmlcloud_tpu.parallel.mesh import create_mesh
+
+
+def resume_on_fresh_mesh(run_dir):
+    mesh = create_mesh({"data": 4})
+    ckpt = CheckpointDir(run_dir)
+    state = ckpt.restore_state()  # BAD: save-time layout on a new mesh
+    return mesh, state
+
+
+def explicit_none_template(run_dir, devices):
+    mesh = Mesh(devices, ("data", "model"))
+    ckpt = CheckpointDir(run_dir)
+    state = ckpt.restore_state(5, template=None)  # BAD: None is no target
+    return mesh, state
+
+
+def resolved_none_positional(run_dir):
+    mesh = create_mesh({"data": 2, "fsdp": 2})
+    tpl = None
+    ckpt = CheckpointDir(run_dir)
+    state = ckpt.restore_state(5, tpl)  # BAD: tpl provably resolves to None
+    return mesh, state

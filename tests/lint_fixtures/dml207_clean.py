@@ -1,0 +1,42 @@
+"""DML207 clean fixture: every restore in mesh-building code names its
+target, and untargeted restores only happen where no mesh is built.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax
+
+from dmlcloud_tpu.checkpoint import CheckpointDir
+from dmlcloud_tpu.parallel.mesh import create_mesh
+
+
+def resharded_restore(run_dir):
+    # the elastic path: restore re-targeted onto the mesh built here
+    mesh = create_mesh({"data": 4})
+    ckpt = CheckpointDir(run_dir)
+    return ckpt.restore_state(mesh=mesh)
+
+
+def templated_restore(run_dir, template):
+    mesh = create_mesh({"data": 2, "fsdp": 2})
+    ckpt = CheckpointDir(run_dir)
+    return mesh, ckpt.restore_state(5, template=template)
+
+
+def positional_template(run_dir, template):
+    mesh = create_mesh({"data": 2})
+    ckpt = CheckpointDir(run_dir)
+    return mesh, ckpt.restore_state(5, template)
+
+
+def host_side_analysis(run_dir):
+    # no mesh built here: host numpy arrays in the saved layout are fine
+    ckpt = CheckpointDir(run_dir)
+    return ckpt.restore_state()
+
+
+def forwarded_kwargs(run_dir, **kwargs):
+    # cannot prove the target absent — trusted
+    mesh = create_mesh({"data": 4})
+    ckpt = CheckpointDir(run_dir)
+    return mesh, ckpt.restore_state(**kwargs)

@@ -130,3 +130,85 @@ def test_committed_receipt_satisfies_the_gate():
     assert gate["spec_decode_speedup_vs_plain"] >= 1.3
     assert gate["spec_decode_accept_rate"] >= 0.6
     assert gate["int8_decode_speedup"] >= 1.2
+
+
+# ----------------------------------------------------------- elastic suite
+
+ELASTIC_RECEIPT = {
+    "steps_replayed": 0,
+    "gate": {
+        "elastic_exact_resume": 1.0,
+        "elastic_save_on_preempt_latency_s": 0.02,
+        "elastic_time_to_resume_s": 0.03,
+    },
+}
+
+
+def test_elastic_gate_passes_against_itself(tmp_path):
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    assert run_gate(base, current=dict(ELASTIC_RECEIPT)) == 0
+
+
+def test_elastic_latencies_are_lower_is_better(tmp_path, capsys):
+    """A latency that GROWS past the (wide) latency tolerance fails; one
+    that merely shrinks — a speedup — always passes."""
+    slow = json.loads(json.dumps(ELASTIC_RECEIPT))
+    slow["gate"]["elastic_time_to_resume_s"] = 0.03 * 2.5  # > 2x baseline
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "elastic_time_to_resume_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(ELASTIC_RECEIPT))
+    fast["gate"]["elastic_save_on_preempt_latency_s"] = 0.001
+    fast["gate"]["elastic_time_to_resume_s"] = 0.001
+    assert run_gate(base, current=fast) == 0
+
+
+def test_elastic_latency_noise_within_2x_passes(tmp_path):
+    noisy = json.loads(json.dumps(ELASTIC_RECEIPT))
+    noisy["gate"]["elastic_save_on_preempt_latency_s"] = 0.02 * 1.8
+    noisy["gate"]["elastic_time_to_resume_s"] = 0.03 * 1.8
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    assert run_gate(base, current=noisy) == 0
+
+
+def test_elastic_replayed_step_fails_exact_resume(tmp_path, capsys):
+    """A drill that replayed (or skipped) even one optimizer step reports
+    elastic_exact_resume 0.0 — a 100% drop, always a FAIL."""
+    replayed = json.loads(json.dumps(ELASTIC_RECEIPT))
+    replayed["steps_replayed"] = 2
+    replayed["gate"]["elastic_exact_resume"] = 0.0
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    assert run_gate(base, current=replayed) == 1
+    assert "elastic_exact_resume" in capsys.readouterr().out
+
+
+def test_elastic_missing_metric_fails(tmp_path, capsys):
+    """Same semantics as the kernel gate: a metric the baseline carries must
+    be present — a drill that silently stopped reporting latency FAILS."""
+    current = {"gate": {"elastic_exact_resume": 1.0}}
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_elastic_suite_with_explicit_files(tmp_path):
+    base = _write(tmp_path, "BENCH_elastic_base.json", ELASTIC_RECEIPT)
+    cur = _write(tmp_path, "cur.json", ELASTIC_RECEIPT)
+    assert gate_main(["--gate", "--suite", "elastic", "--baseline", base, "--current", cur]) == 0
+    assert gate_main(["--gate", "--suite", "nope"]) == 2
+
+
+def test_committed_elastic_receipt_satisfies_the_gate():
+    """The committed PR 7 receipt must pass its own gate and certify exact
+    resumption: 0 steps replayed, a resumable preemption verdict."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_elastic_pr07.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    assert receipt["steps_replayed"] == 0
+    assert receipt["gate"]["elastic_exact_resume"] == 1.0
+    assert receipt["save_on_preempt_latency_s"] > 0
+    assert receipt["time_to_resume_s"] > 0
+    assert receipt["requeue_verdict"]["requeue"] is True

@@ -187,3 +187,75 @@ def test_normalize_opt_handles_arrays_and_recursion():
         return inner
 
     assert _normalize_opt(rec()) == _normalize_opt(rec())
+
+
+class TestSlurmRequeueDiscovery:
+    """The requeue half of the elastic contract (doc/elasticity.md): a
+    Slurm job that is preempted and requeued comes back with the SAME job
+    id in a NEW process — ``find_slurm_checkpoint`` + the indicator/
+    ``.slurm-jobid`` contract files are how attempt 2 finds attempt 1's
+    checkpoint dir without any state surviving in memory."""
+
+    def _attempt1(self, root, job_id, monkeypatch, name="run-a"):
+        monkeypatch.setenv("SLURM_JOB_ID", job_id)
+        ckpt = CheckpointDir(root / name)
+        ckpt.create()
+        return ckpt
+
+    def test_requeue_same_job_id_new_attempt(self, tmp_path, monkeypatch):
+        ckpt = self._attempt1(tmp_path, "777", monkeypatch)
+        # attempt 2: a fresh process (nothing but env + filesystem survive)
+        monkeypatch.setenv("SLURM_JOB_ID", "777")
+        found = find_slurm_checkpoint(tmp_path)
+        assert found == ckpt.path
+        rediscovered = CheckpointDir(found)
+        assert rediscovered.is_valid
+        assert rediscovered.slurm_job_id == "777"
+
+    def test_stale_dir_without_indicator_is_skipped(self, tmp_path, monkeypatch):
+        """A half-created or torn-down dir (``.slurm-jobid`` present but the
+        indicator missing) must not be rediscovered — resuming from it would
+        trust an unvalidated layout."""
+        ckpt = self._attempt1(tmp_path, "777", monkeypatch)
+        ckpt.indicator_file.unlink()
+        assert not ckpt.is_valid
+        assert find_slurm_checkpoint(tmp_path) is None
+
+    def test_plain_file_and_foreign_dirs_are_skipped(self, tmp_path, monkeypatch):
+        (tmp_path / "notes.txt").write_text("not a run dir")
+        (tmp_path / "unrelated").mkdir()  # no indicator, no slurm file
+        other = self._attempt1(tmp_path, "111", monkeypatch, name="other-job")
+        assert other.slurm_job_id == "111"
+        mine = self._attempt1(tmp_path, "777", monkeypatch, name="mine")
+        monkeypatch.setenv("SLURM_JOB_ID", "777")
+        assert find_slurm_checkpoint(tmp_path) == mine.path
+
+    def test_missing_root_or_no_slurm_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SLURM_JOB_ID", "777")
+        assert find_slurm_checkpoint(tmp_path / "never-created") is None
+        monkeypatch.delenv("SLURM_JOB_ID")
+        self._attempt1(tmp_path, "777", monkeypatch, name="later")
+        monkeypatch.delenv("SLURM_JOB_ID")
+        assert find_slurm_checkpoint(tmp_path) is None  # outside Slurm: never guess
+
+    def test_pipeline_resume_rediscovers_by_job_id(self, tmp_path, monkeypatch, single_runtime):
+        """enable_checkpointing(root, resume=True) on a requeued attempt must
+        land on attempt 1's dir (resumed=True), not generate a fresh path."""
+        import dmlcloud_tpu as dml
+
+        ckpt = self._attempt1(tmp_path, "4242", monkeypatch)
+        monkeypatch.setenv("SLURM_JOB_ID", "4242")
+        pipe = dml.TrainingPipeline(name="requeue")
+        pipe.enable_checkpointing(str(tmp_path), resume=True)
+        assert pipe.resumed is True
+        assert pipe.checkpoint_dir.path == ckpt.path
+
+    def test_pipeline_resume_fresh_when_job_id_unknown(self, tmp_path, monkeypatch, single_runtime):
+        import dmlcloud_tpu as dml
+
+        self._attempt1(tmp_path, "4242", monkeypatch)
+        monkeypatch.setenv("SLURM_JOB_ID", "5555")  # a different job entirely
+        pipe = dml.TrainingPipeline(name="requeue")
+        pipe.enable_checkpointing(str(tmp_path), resume=True)
+        assert pipe.resumed is False
+        assert pipe.checkpoint_dir.path.parent == tmp_path

@@ -588,3 +588,61 @@ def test_markov_tokens_learnable_structure():
         for vs in follows.values() if len(vs) >= 5
     ])
     assert 0.8 < frac < 0.99, frac
+
+
+def test_device_iterator_abandonment_joins_prefetch_thread(mesh8):
+    """The preemption drain path: abandoning a host-prefetching
+    device_iterator mid-epoch must stop its background enqueue thread
+    promptly (it would otherwise sit blocked on the full queue until
+    interpreter exit, pinning queued batches and the source iterator)."""
+    import threading
+    import time as _time
+
+    from dmlcloud_tpu.data.device import device_iterator
+
+    def thread_alive():
+        return any(
+            t.name == "dml-host-prefetch" and t.is_alive() for t in threading.enumerate()
+        )
+
+    batches = ({"x": np.full((8, 2), i, np.float32)} for i in range(10_000))
+    it = device_iterator(batches, mesh8, prefetch=1, host_prefetch=2)
+    first = next(it)
+    assert float(first["x"][0, 0]) == 0.0
+    assert thread_alive()  # the producer is live and its queue is full
+
+    it.close()  # consumer abandons the iterator mid-epoch
+
+    deadline = _time.monotonic() + 5.0
+    while thread_alive() and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert not thread_alive(), "host-prefetch thread did not exit after abandonment"
+
+
+def test_feed_close_propagates_through_timed_feed(mesh8):
+    """The stage's telemetry feed wrapper must forward close() to the
+    device iterator (same drain-path promptness, one layer up)."""
+    closed = []
+
+    class Probe:
+        def __iter__(self):
+            try:
+                for i in range(100):
+                    yield {"x": np.full((8, 2), i, np.float32)}
+            finally:
+                closed.append(True)
+
+    from dmlcloud_tpu.stage import TrainValStage
+
+    stage = TrainValStage.__new__(TrainValStage)  # feed plumbing only
+    stage._buckets_resolved = None
+    stage._gp_data_wait_ns = 0
+
+    class _P:
+        mesh = mesh8
+
+    stage.pipeline = _P()
+    feed = stage._timed_feed(Probe())
+    next(feed)
+    feed.close()
+    assert closed == [True]

@@ -1,0 +1,557 @@
+"""Elastic resume: survive preemption, reshard onto a new mesh, resume
+mid-epoch (doc/elasticity.md).
+
+The centerpiece is the **preemption drill**: a training run on a 4-device
+``data`` mesh catches SIGTERM mid-epoch (the real signal path through
+``PreemptionGuard``), drains at the next step-save boundary, writes a
+requeue verdict — and a second run RESUMES ON A 2-DEVICE MESH, finishing
+with parameters matching an uninterrupted control run and zero
+replayed/skipped batches (the total optimizer step count and the loss
+trajectory both certify it).
+
+Around the drill: template-free resharded restore (the sharding sidecar +
+``restore_state(mesh=...)``), composed-mesh coverage matching the
+``dryrun_multichip``/pod-recipe surfaces, checkpoint-save retry fault
+injection, PreemptionGuard semantics, requeue-verdict classification, and
+DataPipeline iterator-state round-trips across world-size changes.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import dmlcloud_tpu as dml
+from dmlcloud_tpu.checkpoint import (
+    CheckpointDir,
+    read_requeue_verdict,
+    write_requeue_verdict,
+)
+from dmlcloud_tpu.data import DataPipeline
+from dmlcloud_tpu.parallel import mesh as mesh_lib
+from dmlcloud_tpu.parallel import runtime
+
+
+def _mesh(n, axes=None):
+    return mesh_lib.create_mesh(axes or {"data": n}, devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# respec_for_mesh: the spec re-targeting primitive
+# ---------------------------------------------------------------------------
+
+class TestRespecForMesh:
+    def test_axis_kept_when_present_and_divisible(self):
+        mesh = _mesh(4, {"data": 2, "fsdp": 2})
+        assert mesh_lib.respec_for_mesh(P("fsdp", None), (8, 4), mesh) == P("fsdp", None)
+
+    def test_missing_axis_dropped(self):
+        mesh = _mesh(2)
+        assert mesh_lib.respec_for_mesh(P("fsdp", None), (8, 4), mesh) == P(None, None)
+
+    def test_non_divisible_axis_relocates(self):
+        # fsdp=4 no longer divides dim 0 (6) but divides dim 1 (8, >= 2*4)
+        mesh = _mesh(4, {"fsdp": 4})
+        assert mesh_lib.respec_for_mesh(P("fsdp", None), (6, 8), mesh) == P(None, "fsdp")
+
+    def test_non_divisible_axis_dropped_with_no_home(self):
+        mesh = _mesh(4, {"fsdp": 4})
+        assert mesh_lib.respec_for_mesh(P("fsdp"), (6,), mesh) == P(None)
+
+    def test_tuple_axes_roundtrip_json(self):
+        spec = P(("data", "fsdp"), None, "model")
+        back = mesh_lib.spec_from_jsonable(
+            json.loads(json.dumps(mesh_lib.spec_to_jsonable(spec)))
+        )
+        assert back == spec
+
+
+# ---------------------------------------------------------------------------
+# template-free resharded restore (the sharding sidecar)
+# ---------------------------------------------------------------------------
+
+def _save_sharded_state(tmp_path, mesh, scope="s"):
+    state = {
+        "params": {
+            "w": jax.device_put(
+                jnp.arange(32.0).reshape(8, 4), NamedSharding(mesh, P("fsdp", None))
+            ),
+            "b": jax.device_put(jnp.ones(4), NamedSharding(mesh, P())),
+        },
+        "step": jax.device_put(jnp.asarray(7), NamedSharding(mesh, P())),
+    }
+    ckpt = CheckpointDir(tmp_path / "run")
+    if not ckpt.is_valid:
+        ckpt.create()
+    ckpt.state_manager(scope, async_save=False)
+    ckpt.save_state(1, state, scope=scope)
+    ckpt.wait_until_finished()
+    return ckpt, state
+
+
+class TestReshardedRestore:
+    def test_sidecar_records_mesh_and_specs(self, tmp_path, single_runtime):
+        ckpt, _ = _save_sharded_state(tmp_path, _mesh(4, {"data": 2, "fsdp": 2}))
+        side = ckpt.read_sharding_sidecar("s", 1)
+        assert side["mesh"] == {"data": 2, "fsdp": 2}
+        assert side["specs"]["params/w"] == ["fsdp", None]
+        assert side["specs"]["params/b"] == []
+        ckpt.close()
+
+    def test_restore_onto_smaller_mesh_without_template(self, tmp_path, single_runtime):
+        """The tentpole contract: a save taken on an N-device mesh restores
+        onto an M-device mesh (N != M) with no caller-built template."""
+        ckpt, state = _save_sharded_state(tmp_path, _mesh(4, {"data": 2, "fsdp": 2}))
+        mesh2 = _mesh(2)
+        restored = ckpt.restore_state(scope="s", mesh=mesh2)
+        w = restored["params"]["w"]
+        assert w.sharding.mesh.shape == {"data": 2}
+        assert w.sharding.spec == P(None, None)  # fsdp axis gone -> replicated
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(state["params"]["w"]))
+        assert int(restored["step"]) == 7
+        ckpt.close()
+
+    def test_restore_onto_larger_mesh(self, tmp_path, single_runtime):
+        ckpt, state = _save_sharded_state(tmp_path, _mesh(2, {"fsdp": 2}))
+        mesh8 = _mesh(8, {"fsdp": 8})
+        restored = ckpt.restore_state(1, scope="s", mesh=mesh8)
+        w = restored["params"]["w"]
+        assert w.sharding.spec == P("fsdp", None)  # 8 divides dim 0 (8)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(state["params"]["w"]))
+        ckpt.close()
+
+    def test_missing_sidecar_degrades_to_policy(self, tmp_path, single_runtime):
+        ckpt, state = _save_sharded_state(tmp_path, _mesh(4, {"data": 2, "fsdp": 2}))
+        ckpt._sharding_sidecar_file("s", 1).unlink()
+        restored = ckpt.restore_state(scope="s", mesh=_mesh(2))
+        w = restored["params"]["w"]
+        assert w.sharding.spec == P()  # default policy: replicate
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(state["params"]["w"]))
+        ckpt.close()
+
+    def test_composed_mesh_pod_surface(self, tmp_path, single_runtime):
+        """The dryrun_multichip / pod-recipe mesh shape: params laid out by
+        T5X-style rules on ('data','fsdp','model'), restored onto a pure
+        ('data','fsdp') mesh of half the devices — the model axis folds
+        away, values survive bit-exact."""
+        from dmlcloud_tpu.models.transformer import llama_partition_rules
+
+        mesh8 = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "model": 2})
+        params = {
+            "layer": {
+                "attention": {"wq": {"kernel": jnp.arange(128.0).reshape(8, 16)}},
+                "mlp": {"wi": {"kernel": jnp.arange(64.0).reshape(8, 8)}},
+            }
+        }
+        params = mesh_lib.shard_pytree(params, mesh8, llama_partition_rules())
+        ckpt = CheckpointDir(tmp_path / "pod")
+        ckpt.create()
+        ckpt.state_manager("pod", async_save=False)
+        ckpt.save_state(1, {"params": params}, scope="pod")
+        ckpt.wait_until_finished()
+
+        mesh4 = _mesh(4, {"data": 2, "fsdp": 2})
+        restored = ckpt.restore_state(scope="pod", mesh=mesh4)["params"]
+        for a, b in zip(
+            jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(params)
+        ):
+            assert a.sharding.mesh.shape == {"data": 2, "fsdp": 2}
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-save retry (transient filesystem errors)
+# ---------------------------------------------------------------------------
+
+class TestSaveRetry:
+    def _ckpt(self, tmp_path):
+        ckpt = CheckpointDir(tmp_path / "retry")
+        ckpt.create()
+        ckpt.state_manager("s", async_save=False)
+        ckpt.save_backoff_s = 0.0  # no sleeping in tests
+        return ckpt
+
+    def test_transient_failure_retried_then_succeeds(self, tmp_path, single_runtime, caplog):
+        ckpt = self._ckpt(tmp_path)
+        mgr = ckpt.state_manager("s")
+        real_save, calls = mgr.save, []
+
+        def flaky(*a, **k):
+            calls.append(1)
+            if len(calls) <= 2:
+                raise OSError("NFS hiccup")
+            return real_save(*a, **k)
+
+        mgr.save = flaky
+        with caplog.at_level("WARNING", logger="dmlcloud_tpu"):
+            ckpt.save_state(1, {"w": jnp.ones(3)}, scope="s")
+        mgr.save = real_save
+        ckpt.wait_until_finished()
+        assert len(calls) == 3
+        assert sum("transient filesystem error" in r.message for r in caplog.records) == 2
+        restored = ckpt.restore_state(1, template={"w": jnp.zeros(3)}, scope="s")
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones(3))
+        ckpt.close()
+
+    def test_persistent_failure_surfaces_original_error(self, tmp_path, single_runtime):
+        ckpt = self._ckpt(tmp_path)
+        mgr = ckpt.state_manager("s")
+        calls = []
+
+        def dead(*a, **k):
+            calls.append(1)
+            raise OSError(f"still down ({len(calls)})")
+
+        mgr.save = dead
+        with pytest.raises(OSError, match="still down \\(1\\)"):
+            ckpt.save_state(1, {"w": jnp.ones(3)}, scope="s")
+        assert len(calls) == ckpt.save_retries
+        ckpt.close()
+
+    def test_non_oserror_not_retried(self, tmp_path, single_runtime):
+        ckpt = self._ckpt(tmp_path)
+        mgr = ckpt.state_manager("s")
+        calls = []
+
+        def broken(*a, **k):
+            calls.append(1)
+            raise ValueError("not transient")
+
+        mgr.save = broken
+        with pytest.raises(ValueError):
+            ckpt.save_state(1, {"w": jnp.ones(3)}, scope="s")
+        assert len(calls) == 1
+        ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# PreemptionGuard
+# ---------------------------------------------------------------------------
+
+class TestPreemptionGuard:
+    def test_signal_flips_flag_and_records_name(self):
+        guard = runtime.PreemptionGuard(signals=("SIGUSR1",)).install()
+        try:
+            assert guard.coordinated() is False
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert guard.triggered is True
+            assert guard.signal_name == "SIGUSR1"
+            assert guard.coordinated() is True
+        finally:
+            guard.uninstall()
+
+    def test_uninstall_restores_disposition_and_disarms(self):
+        prev = signal.getsignal(signal.SIGUSR1)
+        guard = runtime.PreemptionGuard(signals=("SIGUSR1",)).install()
+        guard.uninstall()
+        assert signal.getsignal(signal.SIGUSR1) == prev
+        guard.triggered = True
+        assert guard.coordinated() is False  # disarmed guards never drain
+
+    def test_bad_signal_name_installs_nothing(self):
+        prev = signal.getsignal(signal.SIGUSR1)
+        with pytest.raises(AttributeError):
+            runtime.PreemptionGuard(signals=("SIGUSR1", "SIGNOPE")).install()
+        assert signal.getsignal(signal.SIGUSR1) == prev
+
+    def test_default_signals_add_slurm_warning_signal(self, monkeypatch):
+        monkeypatch.delenv("SLURM_PROCID", raising=False)
+        assert runtime.PreemptionGuard().signals == ("SIGTERM", "SIGINT")
+        monkeypatch.setenv("SLURM_PROCID", "0")
+        assert runtime.PreemptionGuard().signals == ("SIGTERM", "SIGINT", "SIGUSR1")
+
+
+# ---------------------------------------------------------------------------
+# requeue verdict
+# ---------------------------------------------------------------------------
+
+class TestRequeueVerdict:
+    def test_roundtrip_and_schema(self, tmp_path):
+        write_requeue_verdict(tmp_path, True, "drained on SIGTERM", "preemption", epoch=3)
+        v = read_requeue_verdict(tmp_path)
+        assert v["v"] == 1 and v["requeue"] is True and v["kind"] == "preemption"
+        assert v["epoch"] == 3 and "written_at" in v
+
+    def test_corrupt_verdict_reads_none(self, tmp_path):
+        (tmp_path / "requeue.json").write_text("{not json")
+        assert read_requeue_verdict(tmp_path) is None
+
+    def test_classification(self):
+        p = dml.TrainingPipeline(name="cls")
+        assert p._classify_failure(FloatingPointError("nan"))[0] is False
+        assert p._classify_failure(KeyboardInterrupt())[0] is False
+        assert p._classify_failure(OSError("disk"))[0] is True
+        requeue, kind, reason = p._classify_failure(
+            runtime.BarrierTimeout("epoch", 60.0, [3])
+        )
+        assert requeue is True and kind == "hang" and "[3]" in reason
+        assert p._classify_failure(RuntimeError("bug"))[0] is False
+
+    def test_watchdog_dump_fires_on_dump_hook(self, tmp_path):
+        from dmlcloud_tpu.telemetry.watchdog import HangWatchdog
+
+        seen = []
+        wd = HangWatchdog(tmp_path, rank=0, threshold_s=10.0, clock=lambda: 0.0)
+        wd.on_dump = seen.append
+        wd.dump("no progress for 99s")
+        assert seen == ["no progress for 99s"]
+
+
+# ---------------------------------------------------------------------------
+# DataPipeline resumable iterator state
+# ---------------------------------------------------------------------------
+
+class TestDataPipelineState:
+    def test_cursor_counts_and_roundtrips(self, single_runtime):
+        pipe = DataPipeline.from_source(list(range(10)))
+        it = iter(pipe)
+        assert [next(it) for _ in range(4)] == [0, 1, 2, 3]
+        state = pipe.state_dict()
+        assert state == {"v": 1, "epoch": None, "global_offset": 4, "world_size": 1}
+
+        fresh = DataPipeline.from_source(list(range(10)))
+        fresh.load_state_dict(state)
+        assert list(fresh) == [4, 5, 6, 7, 8, 9]
+        # the resumed pass's own cursor continues from the skip
+        assert fresh.state_dict()["global_offset"] == 10
+
+    def test_shuffle_pack_chain_resumes_exactly(self, single_runtime):
+        """The replay fast-forward re-derives reservoir/pack/RNG state: the
+        resumed tail is bit-identical to the uninterrupted pass."""
+
+        def build():
+            p = DataPipeline.from_source(
+                [np.arange(i % 7 + 1, dtype=np.int32) for i in range(40)]
+            )
+            return p.shuffle(8, seed=3).pack(16).batch(2, collate=lambda b: np.stack([x["tokens"] for x in b]))
+
+        ref = build()
+        ref.set_epoch(2)
+        full = list(ref)
+
+        cut = 3
+        interrupted = build()
+        interrupted.set_epoch(2)
+        it = iter(interrupted)
+        for _ in range(cut):
+            next(it)
+        state = interrupted.state_dict()
+        it.close()
+
+        resumed = build()
+        resumed.load_state_dict(state)
+        tail = list(resumed)
+        assert len(tail) == len(full) - cut
+        for a, b in zip(tail, full[cut:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_world_size_change_scales_offset(self, single_runtime, monkeypatch):
+        pipe = DataPipeline.from_source(list(range(12)))
+        it = iter(pipe)
+        for _ in range(3):
+            next(it)
+        monkeypatch.setattr(runtime, "world_size", lambda: 2)
+        state = pipe.state_dict()
+        assert state["global_offset"] == 6 and state["world_size"] == 2
+
+        # resume at world size 3: each rank skips 6 // 3 = 2 of ITS elements
+        monkeypatch.setattr(runtime, "world_size", lambda: 3)
+        fresh = DataPipeline.from_source(list(range(12)))
+        fresh.load_state_dict(state)
+        assert next(iter(fresh)) == 2
+
+    def test_indivisible_offset_warns_and_rounds_down(self, single_runtime, monkeypatch, caplog):
+        pipe = DataPipeline.from_source(list(range(12)))
+        state = {"v": 1, "epoch": None, "global_offset": 7, "world_size": 7}
+        monkeypatch.setattr(runtime, "world_size", lambda: 2)
+        with caplog.at_level("WARNING", logger="dmlcloud_tpu"):
+            pipe.load_state_dict(state)
+        assert pipe._pending_skip == 3
+        assert any("not divisible" in r.message for r in caplog.records)
+
+    def test_bad_state_rejected(self, single_runtime):
+        with pytest.raises(ValueError):
+            DataPipeline.from_source([1]).load_state_dict({"v": 99})
+
+
+# ---------------------------------------------------------------------------
+# THE PREEMPTION DRILL: SIGTERM mid-epoch on data=4, resume on data=2
+# ---------------------------------------------------------------------------
+
+N_BATCHES = 10
+SAVE_EVERY = 2
+KILL_AFTER = 5  # SIGTERM after batch 5 -> drain at the step-6 save boundary
+
+
+def _drill_batches():
+    rng = np.random.RandomState(0)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    xs = rng.randn(N_BATCHES, 8, 4).astype(np.float32)
+    return [{"x": x, "y": x @ w} for x in xs]
+
+
+class _SigtermAfter:
+    """Dataset that delivers a REAL SIGTERM to this process after batch K —
+    the production preemption path, signal handler and all."""
+
+    def __init__(self, batches, kill_after=None):
+        self._batches = batches
+        self._kill_after = kill_after
+        self.fired = False
+
+    def __iter__(self):
+        for i, b in enumerate(self._batches):
+            yield b
+            if self._kill_after is not None and not self.fired and i + 1 == self._kill_after:
+                self.fired = True
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def __len__(self):
+        return len(self._batches)
+
+
+class _DrillStage(dml.TrainValStage):
+    def __init__(self, dataset):
+        super().__init__()
+        self._dataset = dataset
+
+    def checkpoint_every_steps(self):
+        return SAVE_EVERY
+
+    def device_prefetch(self):
+        return 0  # keep batch consumption aligned with steps
+
+    def pre_stage(self):
+        self.pipeline.register_model(
+            "lin",
+            apply_fn=lambda p, x: x @ p["w"],
+            params={"w": jnp.zeros((4, 1))},
+            verbose=False,
+        )
+        self.pipeline.register_optimizer("sgd", optax.sgd(0.05))
+        self.pipeline.register_dataset("train", self._dataset, verbose=False)
+
+    def step(self, state, batch):
+        return jnp.mean((state.apply_fn(state.params, batch["x"]) - batch["y"]) ** 2)
+
+    def val_epoch(self):
+        pass
+
+
+def _drill_run(tmp_path, dataset, n_devices, epochs=2, preemptible=False):
+    pipe = dml.TrainingPipeline(name="drill")
+    pipe.set_mesh(mesh_lib.create_mesh({"data": n_devices}, devices=jax.devices()[:n_devices]))
+    pipe.enable_checkpointing(str(tmp_path), resume=True)
+    if preemptible:
+        pipe.enable_preemption_handling(signals=("SIGTERM",))
+    stage = _DrillStage(dataset)
+    pipe.append_stage(stage, max_epochs=epochs, name="stage")
+    pipe.run()
+    pipe.checkpoint_dir.close()
+    return pipe, stage
+
+
+def test_preemption_drill_reshard_and_resume(tmp_path, single_runtime):
+    """The acceptance drill: SIGTERM mid-epoch on a 4-device mesh; resume on
+    a 2-device mesh; loss trajectory, metric continuity, and exact
+    data-order resumption (0 replayed / 0 skipped batches)."""
+    # control: never interrupted, on the SMALL mesh (the mesh the resumed
+    # run finishes on) — the gold trajectory
+    _, control = _drill_run(tmp_path / "control", _SigtermAfter(_drill_batches()), 2)
+    want = np.asarray(control.state.params["w"])
+    control_losses = [float(v) for v in control.tracker["train/loss"]]
+
+    # phase A: preempted mid-epoch on data=4
+    ds = _SigtermAfter(_drill_batches(), kill_after=KILL_AFTER)
+    pipe1, stage1 = _drill_run(tmp_path / "run", ds, 4, preemptible=True)
+    assert stage1._mid_epoch_exit and stage1._preempt_exit
+    assert int(stage1.state.step) == 6  # drained exactly at the save boundary
+    assert int(stage1.state.params["w"].sharding.mesh.devices.size) == 4
+
+    # the drain left a machine-readable requeue verdict with the save latency
+    verdict = read_requeue_verdict(pipe1.checkpoint_dir.path)
+    assert verdict["requeue"] is True and verdict["kind"] == "preemption"
+    assert "SIGTERM" in verdict["reason"]
+    assert verdict["mid_epoch"] is True and verdict["epoch"] == 1
+    assert verdict["save_on_preempt_latency_s"] > 0
+
+    # the step save carries a sharding sidecar for the 4-device mesh
+    side = pipe1.checkpoint_dir.read_sharding_sidecar("stage.steps", 6)
+    assert side["mesh"] == {"data": 4}
+
+    # phase B: the requeue — SAME run dir, HALF the devices
+    pipe2, stage2 = _drill_run(pipe1.checkpoint_dir.path, _SigtermAfter(_drill_batches()), 2)
+    # exact data-order resumption: 2 epochs x 10 batches, not one step more
+    # or less — a replayed or skipped batch cannot produce step == 20
+    assert int(stage2.state.step) == 2 * N_BATCHES
+    assert stage2.current_epoch == 3
+    assert int(stage2.state.params["w"].sharding.mesh.devices.size) == 2
+
+    # loss trajectory: same computation as the uninterrupted control (only
+    # collective reduction order differs between the meshes)
+    got = np.asarray(stage2.state.params["w"])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    resumed_losses = [float(v) for v in stage2.tracker["train/loss"]]
+    assert len(resumed_losses) == 2  # metric continuity: both epochs recorded
+    # epoch 2 saw identical data from identical params on both runs
+    np.testing.assert_allclose(resumed_losses[1], control_losses[1], rtol=1e-5)
+    # the completed requeue verdict stands down
+    v2 = read_requeue_verdict(pipe2.checkpoint_dir.path)
+    assert v2["requeue"] is False and v2["kind"] == "completed"
+
+
+def test_drill_with_resumable_datapipeline(tmp_path, single_runtime):
+    """Same drill with a DataPipeline train dataset: the step-save sidecar
+    carries the iterator state and the resume fast-forwards through
+    ``load_state_dict`` instead of the raw batch skip."""
+    batches = _drill_batches()
+
+    class _PipelineSigterm(_SigtermAfter):
+        pass
+
+    def make_ds(kill_after=None):
+        return DataPipeline.from_source(_PipelineSigterm(batches, kill_after))
+
+    _, control = _drill_run(tmp_path / "control", make_ds(), 2)
+    want = np.asarray(control.state.params["w"])
+
+    pipe1, stage1 = _drill_run(tmp_path / "run", make_ds(kill_after=KILL_AFTER), 4, preemptible=True)
+    assert int(stage1.state.step) == 6
+    meta = json.loads(
+        (pipe1.checkpoint_dir.path / "meta" / "stage.steps" / "6.json").read_text()
+    )
+    assert meta["world_size"] == 1
+    assert meta["data"] == {"v": 1, "epoch": 1, "global_offset": 6, "world_size": 1}
+
+    pipe2, stage2 = _drill_run(pipe1.checkpoint_dir.path, make_ds(), 2)
+    assert int(stage2.state.step) == 2 * N_BATCHES
+    np.testing.assert_allclose(np.asarray(stage2.state.params["w"]), want, rtol=1e-5, atol=1e-6)
+
+
+def test_nan_failure_writes_no_requeue_verdict(tmp_path, single_runtime):
+    """A deterministic failure (non-finite loss) must NOT ask for a requeue
+    — it would recur forever."""
+
+    class NaNStage(_DrillStage):
+        def log_every(self):
+            return 1
+
+        def step(self, state, batch):
+            return jnp.mean(batch["x"]) * jnp.float32(np.nan)
+
+    pipe = dml.TrainingPipeline(name="nan")
+    pipe.enable_checkpointing(str(tmp_path), resume=True)
+    stage = NaNStage(_drill_batches())
+    pipe.append_stage(stage, max_epochs=1, name="stage")
+    with pytest.raises(FloatingPointError):
+        pipe.run()
+    verdict = read_requeue_verdict(pipe.checkpoint_dir.path)
+    assert verdict["requeue"] is False and verdict["kind"] == "exception"
+    pipe.checkpoint_dir.close()

@@ -42,6 +42,7 @@ BAD_EXPECT = {
     "DML204": 3,
     "DML205": 3,
     "DML206": 3,
+    "DML207": 3,
     "DML301": 2,
     "DML302": 2,
 }
