@@ -969,6 +969,173 @@ def bench_elastic(timeout_s: int = 900) -> dict | None:
     return None
 
 
+# ------------------------------------------------------- serving engine bench
+
+_SERVE_MARKER = "SERVE_BENCH_RESULTS "
+
+#: the CPU-smoke serving A/B config — pinned so receipts stay comparable.
+#: fp32 (XLA:CPU's native GEMM dtype): the token-identity check is exact
+#: and neither arm pays the bf16 emulation tax. The model is sized so that
+#: decode is weight-bandwidth-bound (~24M params streamed per token — the
+#: regime serving actually lives in; a toy model would measure Python
+#: dispatch, which batching cannot amortise). The Poisson arrivals
+#: saturate both arms (mean interarrival far below the serial per-request
+#: service time), so tokens/s measures each arm's max sustainable
+#: throughput and TTFT measures behavior under queueing load.
+_SERVE_CFG = dict(
+    vocab=2048, layers=6, heads=8, kv=4, head_dim=64, hidden=512, mlp=1408,
+    max_seq_len=160, n_requests=24, prompt_lens=(16, 32, 48),
+    new_tokens=(24, 32, 48), mean_interarrival_s=0.02, seed=0,
+    block_size=16, num_blocks=96, max_slots=8, prefill_chunk=32,
+)
+
+
+def _serve_model():
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    c = _SERVE_CFG
+    cfg = TransformerConfig(
+        vocab_size=c["vocab"], num_layers=c["layers"], num_heads=c["heads"],
+        num_kv_heads=c["kv"], head_dim=c["head_dim"], hidden_dim=c["hidden"],
+        mlp_dim=c["mlp"], max_seq_len=c["max_seq_len"], dtype=jnp.float32,
+    )
+    model = DecoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _serve_trace():
+    """The pinned Poisson request trace: (offset_s, prompt, max_new) per
+    request, offsets ascending. Prompt/generation lengths cycle through
+    the pinned sets so both arms see the same bounded signature mix."""
+    c = _SERVE_CFG
+    rs = np.random.RandomState(c["seed"])
+    offsets = np.cumsum(rs.exponential(c["mean_interarrival_s"], c["n_requests"]))
+    trace = []
+    for i in range(c["n_requests"]):
+        pl = c["prompt_lens"][i % len(c["prompt_lens"])]
+        new = c["new_tokens"][i % len(c["new_tokens"])]
+        prompt = rs.randint(0, c["vocab"], size=pl).astype(np.int32)
+        trace.append((float(offsets[i]), prompt, int(new)))
+    return trace
+
+
+def _serve_serial_arm(model, params, trace):
+    """The baseline: serial ``generate()`` calls replayed against the same
+    arrival times. Each request is serviced alone, FIFO; its first token
+    exists only when its whole compiled generate returns, so TTFT =
+    completion - arrival (that is the honest serial number — the one
+    compiled program emits nothing incrementally). Signatures are warmed
+    before the timed replay, same as the engine arm."""
+    from dmlcloud_tpu.models.generate import generate
+
+    sigs = {}
+    for _, prompt, new in trace:
+        sigs.setdefault((prompt.size, new), prompt)
+    for (_, new), prompt in sigs.items():
+        np.asarray(generate(model, params, jnp.asarray(prompt)[None], new))
+
+    outs, ttfts = [], []
+    t_free = total_tokens = 0.0
+    for off, prompt, new in trace:
+        start = max(off, t_free)
+        t0 = time.perf_counter()
+        out = np.asarray(generate(model, params, jnp.asarray(prompt)[None], new))
+        done = start + (time.perf_counter() - t0)
+        ttfts.append(done - off)
+        t_free = done
+        total_tokens += new
+        outs.append(out[0])
+    wall = t_free - trace[0][0]
+    return {
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "p50_ttft_s": round(float(np.percentile(ttfts, 50)), 4),
+        "p99_ttft_s": round(float(np.percentile(ttfts, 99)), 4),
+        "wall_s": round(wall, 3),
+    }, outs
+
+
+def serve_child_main():
+    """A/B the continuous-batching engine against serial ``generate()`` on
+    the pinned Poisson trace (CPU-pinned child); prints one marker line of
+    JSON — the source of ``BENCH_serve_*.json`` and of ``bench.py --gate
+    --suite serve``'s current numbers."""
+    jax.config.update("jax_platforms", "cpu")
+    from dmlcloud_tpu.serve import ServeEngine
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c = _SERVE_CFG
+    model, params = _serve_model()
+    trace = _serve_trace()
+
+    serial, serial_outs = _serve_serial_arm(model, params, trace)
+
+    engine = ServeEngine(
+        model, params, num_blocks=c["num_blocks"], block_size=c["block_size"],
+        max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"],
+    )
+    # warm pass: same trace, zero offsets — compiles every signature the
+    # replay will hit (per-engine jit cache), then measure fresh
+    engine.serve_trace([(0.0, p, n) for _, p, n in trace])
+    warm_outs = [engine.output(i) for i in range(len(trace))]
+    engine.ledger = ServeLedger()
+    summary = engine.serve_trace(trace)
+
+    identical = all(
+        np.array_equal(w, s[: len(w)]) and len(w) == len(s)
+        for w, s in zip(warm_outs, serial_outs)
+    )
+    speedup = (
+        round(summary["tokens_per_sec"] / serial["tokens_per_sec"], 3)
+        if summary["tokens_per_sec"] and serial["tokens_per_sec"]
+        else None
+    )
+    results = {
+        "config": dict(c),
+        "value_source": "cpu_smoke",
+        "serial": serial,
+        "engine": {
+            **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in summary.items()},
+            "compiled_signatures": engine.compiled_signatures(),
+            "max_signatures": engine.max_signatures,
+        },
+        "speedup_tokens_per_sec": speedup,
+        "token_identical_to_serial": identical,
+        # the flat, schema-stable section the perf gate compares
+        "gate": {
+            "serve_tokens_per_sec_speedup": speedup,
+            "serve_engine_tokens_per_sec": summary["tokens_per_sec"],
+            "serve_p99_ttft_s": summary["p99_ttft_s"],
+        },
+    }
+    print(_SERVE_MARKER + json.dumps(results), flush=True)
+
+
+def bench_serve(timeout_s: int = 1200) -> dict | None:
+    """Run the serving A/B in a CPU-pinned child; returns its results
+    dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_SERVE_MARKER):
+            try:
+                return json.loads(line[len(_SERVE_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 # --------------------------------------------------------------- perf gate
 
 #: relative drop in a gate metric that fails the gate (15%: comfortably
@@ -983,7 +1150,7 @@ _GATE_GOODPUT_KEYS = ("goodput_frac",)
 #: gate metrics where SMALLER is better (the elastic drill's latencies);
 #: everything else is a speedup/ratio where bigger is better
 _GATE_LOWER_IS_BETTER = frozenset(
-    {"elastic_save_on_preempt_latency_s", "elastic_time_to_resume_s"}
+    {"elastic_save_on_preempt_latency_s", "elastic_time_to_resume_s", "serve_p99_ttft_s"}
 )
 
 #: relative GROWTH allowed for the lower-is-better latency metrics (100%:
@@ -1078,14 +1245,18 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
 
 
 def gate_main(argv: list) -> int:
-    """``bench.py --gate [--suite kernels|elastic|all] [--baseline B.json]
-    [--current C.json] [--tolerance 0.15]`` — CI regression gate over the
-    committed receipts (scripts/perf_gate.sh wires it into the lint-gate
-    flow). The ``kernels`` suite (default) measures the kernel A/Bs; the
-    ``elastic`` suite runs the preemption drill and compares its metrics
-    against the last committed ``BENCH_elastic_*.json`` (exact resume,
-    save-on-preempt latency, time-to-resume — a missing metric FAILS, same
-    as the kernel gate); ``all`` chains both and fails on the worst."""
+    """``bench.py --gate [--suite kernels|elastic|serve|all] [--baseline
+    B.json] [--current C.json] [--tolerance 0.15]`` — CI regression gate
+    over the committed receipts (scripts/perf_gate.sh wires it into the
+    lint-gate flow). The ``kernels`` suite (default) measures the kernel
+    A/Bs; the ``elastic`` suite runs the preemption drill and compares its
+    metrics against the last committed ``BENCH_elastic_*.json`` (exact
+    resume, save-on-preempt latency, time-to-resume); the ``serve`` suite
+    replays the Poisson serving A/B against the last committed
+    ``BENCH_serve_*.json`` (tokens/s speedup vs serial generate, absolute
+    engine tokens/s, p99 TTFT as a lower-is-better latency). A missing
+    metric FAILS in every suite; ``all`` chains them and fails on the
+    worst."""
 
     def _opt(flag, default=None):
         if flag in argv:
@@ -1096,8 +1267,8 @@ def gate_main(argv: list) -> int:
 
     suite = _opt("--suite", "kernels")
     tolerance = float(_opt("--tolerance", _GATE_TOLERANCE))
-    if suite not in ("kernels", "elastic", "all"):
-        print(f"gate: unknown --suite {suite!r} (kernels|elastic|all)", file=sys.stderr)
+    if suite not in ("kernels", "elastic", "serve", "all"):
+        print(f"gate: unknown --suite {suite!r} (kernels|elastic|serve|all)", file=sys.stderr)
         return 2
 
     rcs = []
@@ -1120,6 +1291,20 @@ def gate_main(argv: list) -> int:
             current = bench_elastic()
             if current is None:
                 print("gate: FAIL — elastic drill child produced no results", file=sys.stderr)
+                return 2
+        rcs.append(run_gate(baseline, current, tolerance))
+    if suite in ("serve", "all"):
+        baseline = _opt("--baseline") if suite == "serve" else None
+        baseline = baseline or _latest_receipt("serve")
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_serve_*.json", file=sys.stderr)
+            return 2
+        current = _opt("--current") if suite == "serve" else None
+        if current is None:
+            print("gate: running the serving A/B (serve suite child)...", file=sys.stderr)
+            current = bench_serve()
+            if current is None:
+                print("gate: FAIL — serve bench child produced no results", file=sys.stderr)
                 return 2
         rcs.append(run_gate(baseline, current, tolerance))
     return max(rcs)
@@ -2123,6 +2308,8 @@ if __name__ == "__main__":
         kernels_child_main()
     elif "--elastic-child" in sys.argv[1:]:
         elastic_child_main()
+    elif "--serve-child" in sys.argv[1:]:
+        serve_child_main()
     elif "--probe-child" in sys.argv[1:]:
         probe_child_main()
     elif "--gate" in sys.argv[1:]:

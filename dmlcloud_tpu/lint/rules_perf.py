@@ -1,7 +1,7 @@
-"""The donation/remat performance-contract rules (DML205-DML206).
+"""The donation/remat/allocation performance-contract rules (DML205-DML208).
 
-PR 6's kernel pass made the hot paths fast; these rules make the two
-memory contracts that keep them fast checkable on CPU:
+PR 6's kernel pass made the hot paths fast; these rules make the memory
+contracts that keep them fast checkable on CPU:
 
 - DML205  a jitted train/decode step that RETURNS an updated version of a
           TrainState / optimizer-state / KV-cache argument without
@@ -10,6 +10,11 @@ memory contracts that keep them fast checkable on CPU:
 - DML206  ``lax.scan``/``nn.scan`` over a layer stack without a remat
           policy — every layer's activations are saved for the backward,
           so activation memory grows with depth instead of staying O(1)
+- DML208  ``init_cache(...)`` / ``KVBlockPool(...)`` — a full KV-cache
+          allocation — inside a ``for``/``while`` body: a serve/request
+          loop that reallocates the cache per request churns the biggest
+          allocation in the program every iteration instead of reusing a
+          pool (serve/kv_pool.py) or rewinding (generate.rewind_cache)
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -40,7 +45,7 @@ from .engine import (
 )
 from .rules import _is_trainish
 
-__all__ = ["check_step_donation", "check_scan_remat"]
+__all__ = ["check_step_donation", "check_scan_remat", "check_cache_alloc_in_loop"]
 
 
 def _f(ctx: ModuleCtx, rule_id: str, node: ast.AST, message: str, context: str = "") -> Finding:
@@ -226,6 +231,78 @@ def _bare_layer_call(ctx: ModuleCtx, body: ast.AST, scopes) -> ast.Call | None:
         if seg and _LAYERISH.search(seg):
             return node
     return None
+
+
+# ------------------------------------------------------------------- DML208
+
+#: callables whose result is a FULL KV cache / cache pool — the biggest
+#: single allocation an inference program makes
+_CACHE_ALLOC_NAMES = frozenset({"init_cache", "KVBlockPool"})
+
+
+def _cache_alloc_name(ctx: ModuleCtx, node: ast.Call, scopes) -> str | None:
+    """The cache-allocator name a call resolves to, chasing import aliases
+    (``gen.init_cache``) and local assignment aliases (``alloc =
+    init_cache; alloc(...)``) through the dataflow core. None when the
+    callee is provably something else or unresolvable."""
+    func = node.func
+    resolved = ctx.resolve(func) or ""
+    last = resolved.split(".")[-1] if resolved else ""
+    if not last and isinstance(func, ast.Attribute):
+        last = func.attr
+    if last in _CACHE_ALLOC_NAMES:
+        return last
+    if isinstance(func, ast.Name):
+        bound = dataflow.resolve_expr(func, scopes)
+        if bound is not None and bound is not func:
+            chained = (ctx.resolve(bound) or "").split(".")[-1]
+            if not chained and isinstance(bound, ast.Name):
+                chained = bound.id
+            if chained in _CACHE_ALLOC_NAMES:
+                return chained
+    return None
+
+
+@rule("DML208", "full KV-cache allocation inside a request/serve loop")
+def check_cache_alloc_in_loop(ctx: ModuleCtx):
+    """``init_cache(...)`` builds the full ``[B, S, KH, D]``-per-layer
+    cache tree; ``KVBlockPool(...)`` builds the whole page pool. Either
+    one inside a ``for``/``while`` body — the shape of a request/serve
+    loop — reallocates (and re-zeroes, and re-uploads) the single biggest
+    buffer in an inference program once per iteration: allocation churn
+    that fragments HBM and stalls the loop on every request. Allocate
+    ONCE before the loop and reuse it — a pool recycles blocks per
+    request (serve/kv_pool.py), a dense cache rewinds
+    (``generate.rewind_cache``). Flow-aware: callee names are chased
+    through import and assignment aliases; functions *defined* inside the
+    loop run at call time, not per iteration, and are skipped (same
+    exemption as DML107)."""
+
+    def visit(node: ast.AST, in_loop: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # the nested body executes when called, not per iteration
+                yield from visit(child, False)
+                continue
+            if in_loop and isinstance(child, ast.Call):
+                name = _cache_alloc_name(ctx, child, ctx.scopes_at(child))
+                if name is not None:
+                    fn = ctx.enclosing_function(child)
+                    yield _f(
+                        ctx, "DML208", child,
+                        f"{name}(...) inside a loop body reallocates the full KV "
+                        "cache every iteration (allocation churn on the biggest "
+                        "buffer in the program); allocate once before the "
+                        "request/serve loop and reuse it — recycle pool blocks "
+                        "(serve.KVBlockPool) or rewind the dense cache "
+                        "(generate.rewind_cache)",
+                        getattr(fn, "name", ""),
+                    )
+            yield from visit(
+                child, in_loop or isinstance(child, (ast.For, ast.AsyncFor, ast.While))
+            )
+
+    yield from visit(ctx.tree, False)
 
 
 @rule("DML206", "scan over a layer stack without a remat policy")

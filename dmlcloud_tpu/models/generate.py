@@ -84,7 +84,33 @@ def _chunked_scan(step, carry, first_step, n_total, attend_len_for_end):
     return carry, ys
 
 
-def _sample(logits, rng, temperature: float, top_k: int, top_p: float):
+def decode_step(
+    model: DecoderLM, params, tokens, cache, *, offset=0, pad_len=None, attend_len=None,
+    pages=None, adapters=None,
+):
+    """THE cache-step primitive: one model application that writes
+    ``tokens``' K/V into ``cache`` and returns ``(logits, new_cache)``.
+
+    Every decode path — :func:`generate`, :func:`beam_search`,
+    ``speculative_generate`` and the continuous-batching serving engine
+    (``dmlcloud_tpu.serve``) — funnels its cache-carrying model calls
+    through this one function, so the cache write/attend convention (write
+    the slot BEFORE attention reads it, causal mask over the filled
+    prefix) cannot drift between them: a numerics change lands in all four
+    at once or not at all.
+
+    ``cache`` is either the dense ``init_cache`` tree stepped at the
+    scalar ``offset`` (with optional ``pad_len`` ragged-prompt positions
+    and ``attend_len`` bounded reads), or the serving engine's pool pages
+    stepped via ``pages=(block_tables, fill)``; ``adapters`` threads
+    per-row LoRA deltas for multi-tenant serving (``serve.AdapterSet``)."""
+    return model.apply(
+        {"params": params}, tokens, cache=cache, offset=offset, pad_len=pad_len,
+        attend_len=attend_len, pages=pages, adapters=adapters,
+    )
+
+
+def sample_logits(logits, rng, temperature: float, top_k: int, top_p: float):
     """logits: [B, V] fp32 -> tokens [B] int32."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -145,13 +171,13 @@ def _generate_compiled(
     # Left padding means every row's LAST slot is real, so sampling reads
     # logits[:, -1] and decode write offsets stay uniform across rows.
     # attend_len=t: the empty generation tail is never read.
-    logits, cache = model.apply(
-        {"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len, attend_len=t
+    logits, cache = decode_step(
+        model, params, prompt, cache, offset=0, pad_len=pad_len, attend_len=t
     )
     last = logits[:, -1]  # [B, V]
 
     def sample_next(prev_logits, rng, done):
-        tok = _sample(prev_logits, rng, temperature, top_k, top_p)
+        tok = sample_logits(prev_logits, rng, temperature, top_k, top_p)
         tok = jnp.where(done, pad_id, tok)
         return tok, done | (tok == eos_id)
 
@@ -159,8 +185,8 @@ def _generate_compiled(
         cache, prev_logits, rng, done = carry
         rng, sub = jax.random.split(rng)
         tok, done = sample_next(prev_logits, sub, done)
-        logits, cache = model.apply(
-            {"params": params}, tok[:, None], cache=cache, offset=t + i, pad_len=pad_len,
+        logits, cache = decode_step(
+            model, params, tok[:, None], cache, offset=t + i, pad_len=pad_len,
             attend_len=attend_len,
         )
         return (cache, logits[:, 0], rng, done), tok
@@ -271,8 +297,8 @@ def _beam_search_compiled(
         params = widen_quant_tree(params)
     # Prefill once per batch row, then tile the cache across beams.
     cache = init_cache(model.cfg, b, t + max_new_tokens, dtype=model.cfg.dtype)
-    logits, cache = model.apply(
-        {"params": params}, prompt, cache=cache, offset=0, pad_len=pad_len, attend_len=t
+    logits, cache = decode_step(
+        model, params, prompt, cache, offset=0, pad_len=pad_len, attend_len=t
     )
     cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, k, axis=0), cache)  # [B*K, ...]
     pad_len_k = None if pad_len is None else jnp.repeat(pad_len, k, axis=0)  # beam-tiled
@@ -288,8 +314,8 @@ def _beam_search_compiled(
     def step(carry, i, attend_len):
         cache, tokens, scores, lengths, finished, last_tok = carry
         # last_tok was emitted at position t + i - 1; its K/V lands there
-        logits, cache = model.apply(
-            {"params": params}, last_tok.reshape(b * k, 1), cache=cache, offset=t + i - 1,
+        logits, cache = decode_step(
+            model, params, last_tok.reshape(b * k, 1), cache, offset=t + i - 1,
             pad_len=pad_len_k, attend_len=attend_len,
         )
         lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)).reshape(b, k, v)

@@ -45,7 +45,14 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-__all__ = ["LoraPair", "lora_init", "lora_merge", "lora_size", "default_match"]
+__all__ = [
+    "LoraPair",
+    "lora_init",
+    "lora_merge",
+    "lora_size",
+    "default_match",
+    "batched_lora_delta",
+]
 
 
 class LoraPair(struct.PyTreeNode):
@@ -86,6 +93,7 @@ def lora_init(
     params: Any,
     rank: int = 8,
     match: str | Callable[[str, Any], bool] | None = None,
+    in_axes: int | None = None,
 ) -> Any:
     """Adapter tree for ``params``: matched leaves become ``LoraPair``
     factor pairs, everything else becomes None (so the tree stays
@@ -97,7 +105,17 @@ def lora_init(
     init), ``b`` is ``[rank, out]`` zeros — the merged model starts exactly
     at the base. ``match`` is the ``default_match`` kernel predicate, a
     regex over '/'-joined param paths, or an explicit ``(path, leaf) ->
-    bool`` callable."""
+    bool`` callable.
+
+    ``in_axes`` picks how a rank-``n`` kernel's axes split between the LoRA
+    "in" and "out" dims: the leading ``in_axes`` axes collapse into "in",
+    the rest into "out". The default (``None``) keeps the historical
+    all-but-last split. Batched multi-tenant serving
+    (:class:`dmlcloud_tpu.serve.AdapterSet`) requires ``in_axes=1`` — the
+    factored per-request application ``(x @ a) @ b`` only works when ``a``
+    contracts against the layer INPUT, i.e. the kernel's first axis.
+    ``lora_merge`` accepts either split (the delta reshape is
+    factorization-agnostic)."""
     matcher = _as_matcher(match)
     paths = _paths(params)
     counter = [0]
@@ -105,10 +123,16 @@ def lora_init(
     def init_leaf(path, leaf):
         if not matcher(path, leaf):
             return None
-        d_in = 1
-        for s in leaf.shape[:-1]:
+        n_in = leaf.ndim - 1 if in_axes is None else int(in_axes)
+        if not 1 <= n_in < leaf.ndim:
+            raise ValueError(
+                f"in_axes must be in [1, ndim) for {path!r} (ndim {leaf.ndim}), got {n_in}"
+            )
+        d_in = d_out = 1
+        for s in leaf.shape[:n_in]:
             d_in *= int(s)
-        d_out = int(leaf.shape[-1])
+        for s in leaf.shape[n_in:]:
+            d_out *= int(s)
         counter[0] += 1
         key = jax.random.fold_in(rng, counter[0])
         a = jax.random.normal(key, (d_in, rank), jnp.float32) / jnp.sqrt(d_in)
@@ -135,6 +159,20 @@ def lora_merge(base: Any, adapters: Any, alpha: float = 16.0) -> Any:
     return jax.tree_util.tree_map(
         merge_leaf, adapters, base, is_leaf=lambda x: x is None or isinstance(x, LoraPair)
     )
+
+
+def batched_lora_delta(x: jax.Array, a: jax.Array, b: jax.Array, scale: float = 1.0) -> jax.Array:
+    """Per-row LoRA delta for multi-tenant batched serving: each batch row
+    applies ITS OWN adapter, gathered by request id before the call.
+
+    ``x`` is the layer input ``[B, T, d_in]``; ``a``/``b`` are the
+    already-gathered per-row factors ``[B, d_in, r]`` / ``[B, r, d_out]``
+    (``AdapterSet`` stacks every tenant's pair and indexes by adapter id).
+    Returns the fp32 delta ``[B, T, d_out]`` = ``(x @ a_row) @ b_row *
+    scale`` — the ``lora_merge``-free application order: rank-r work per
+    token instead of materialising any per-row ``d_in x d_out`` weight."""
+    h = jnp.einsum("btd,bdr->btr", x.astype(jnp.float32), a.astype(jnp.float32))
+    return jnp.einsum("btr,bro->bto", h, b.astype(jnp.float32)) * scale
 
 
 def lora_size(adapters: Any) -> int:

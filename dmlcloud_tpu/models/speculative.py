@@ -86,7 +86,7 @@ def _row_spec_decode(
     return_stats: bool = False,  # static: also return (rounds, advanced, accepted)
     return_cache: bool = False,  # static: also return the rewound KV caches
 ):
-    from .generate import init_cache, rewind_cache
+    from .generate import decode_step, init_cache, rewind_cache
     from .quant import dequant_tree, widen_quant_tree
 
     # int8 kernels stay quantized for the fused QuantDense path; only
@@ -113,11 +113,11 @@ def _row_spec_decode(
     # Prefill both models over the prompt. attend_len=None: these are
     # one-time full passes, the fill-proportional chunking that matters in
     # plain decode buys little across a single prefill.
-    tlogits, tcache = target.apply(
-        {"params": target_params}, row, cache=tcache, offset=0, pad_len=pad_len, attend_len=t
+    tlogits, tcache = decode_step(
+        target, target_params, row, tcache, offset=0, pad_len=pad_len, attend_len=t
     )
-    _, dcache = draft.apply(
-        {"params": draft_params}, row, cache=dcache, offset=0, pad_len=pad_len, attend_len=t
+    _, dcache = decode_step(
+        draft, draft_params, row, dcache, offset=0, pad_len=pad_len, attend_len=t
     )
 
     def _pick(logits, key):
@@ -170,15 +170,15 @@ def _row_spec_decode(
         # fully-accepted round (see module docstring) and is an identical
         # rewrite otherwise; its last-position logits propose d_1.
         first2 = jax.lax.dynamic_slice(y, (pos - 2,), (2,))[None]  # [1, 2]
-        logits, dcache = draft.apply(
-            {"params": draft_params}, first2, cache=s["dcache"],
+        logits, dcache = decode_step(
+            draft, draft_params, first2, s["dcache"],
             offset=pos - 2, pad_len=pad_len, attend_len=cache_len,
         )
         nxt = pick_draft(logits[0, -1], 0)
         props, drows = [nxt], [logits[0, -1]]
         for i in range(1, k):  # k-1 single-token passes
-            logits, dcache = draft.apply(
-                {"params": draft_params}, nxt[None, None], cache=dcache,
+            logits, dcache = decode_step(
+                draft, draft_params, nxt[None, None], dcache,
                 offset=pos - 1 + i, pad_len=pad_len, attend_len=cache_len,
             )
             nxt = pick_draft(logits[0, 0], i)
@@ -192,13 +192,9 @@ def _row_spec_decode(
 
         # --- target verifies y[pos-1], d_1..d_k in one pass ---
         x = jnp.concatenate([s["y"][pos - 1][None], proposals])[None]  # [1, k+1]
-        tlogits, tcache = target.apply(
-            {"params": target_params},
-            x,
-            cache=s["tcache"],
-            offset=pos - 1,
-            pad_len=pad_len,
-            attend_len=cache_len,
+        tlogits, tcache = decode_step(
+            target, target_params, x, s["tcache"],
+            offset=pos - 1, pad_len=pad_len, attend_len=cache_len,
         )
 
         if not sampled:

@@ -207,11 +207,36 @@ def _dot_attention(q, k, v, causal: bool = True, mask: jnp.ndarray | None = None
     return out.reshape(b, t, h, d)
 
 
+def _adapter_add(y, inp, name, adapters):
+    """Add the per-row LoRA delta for dense ``name`` when ``adapters``
+    carries a stacked pair for it (multi-tenant serving; see
+    ``serve.AdapterSet``). ``adapters`` is ``(subtree, ids)`` — the
+    lora-init-shaped subtree for the enclosing module and the per-row
+    adapter ids — or None. Rows gather their own factors by id; the delta
+    is the merge-free ``(x @ a) @ b`` order (``lora.batched_lora_delta``,
+    ``b`` pre-scaled by alpha/rank at stacking time)."""
+    if adapters is None:
+        return y
+    from .lora import LoraPair, batched_lora_delta
+
+    sub, ids = adapters
+    pair = (sub or {}).get(name)
+    if isinstance(pair, dict):
+        pair = pair.get("kernel")
+    if not isinstance(pair, LoraPair):
+        return y
+    delta = batched_lora_delta(inp, pair.a[ids], pair.b[ids])
+    return y + delta.reshape(y.shape).astype(y.dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None):
+    def __call__(
+        self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None,
+        paged=None, adapters=None,
+    ):
         from .quant import QuantDenseGeneral
 
         cfg = self.cfg
@@ -221,13 +246,19 @@ class Attention(nn.Module):
             feats, axis=-1, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
         )
         b, t, _ = x.shape
-        q = dense((cfg.num_heads, cfg.head_dim), "q_proj")(x)
-        k = dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x)
-        v = dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x)
+        q = _adapter_add(dense((cfg.num_heads, cfg.head_dim), "q_proj")(x), x, "q_proj", adapters)
+        k = _adapter_add(dense((cfg.kv_heads, cfg.head_dim), "k_proj")(x), x, "k_proj", adapters)
+        v = _adapter_add(dense((cfg.kv_heads, cfg.head_dim), "v_proj")(x), x, "v_proj", adapters)
 
-        if seg_info is None and decode_pad is None:
+        if seg_info is None and decode_pad is None and paged is None:
             q = apply_rope(q, cos, sin, offset=offset)
             k = apply_rope(k, cos, sin, offset=offset)
+        elif paged is not None:
+            # paged decode: every row sits at its own absolute position
+            # (fill + step offset) — precomputed once in DecoderLM
+            _, _, positions = paged
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
         elif decode_pad is not None:
             # left-padded ragged prompts: per-row positions (real tokens
             # count from 0 at each row's first real slot)
@@ -252,6 +283,29 @@ class Attention(nn.Module):
                 )
             else:
                 out = _dot_attention(q, k, v, mask=mask)
+        elif paged is not None:
+            # Paged decode (the serving engine's path): the cache leaves
+            # are the POOL pages [num_blocks, block_size, KH, D]. Write the
+            # new K/V into the pages each row's block table names, then
+            # gather the table back into a contiguous [B, NB*bs, KH, D]
+            # view and run the SAME masked attention as the dense path —
+            # identical math, memory owned by the pool. Sentinel table
+            # entries drop the writes of padded rows and clip the gathers
+            # into masked positions (ops/paged_attention.py).
+            from ..ops.paged_attention import gather_pages, scatter_tokens
+
+            tables, fill, positions = paged
+            k_pool = scatter_tokens(cache["k"], tables, positions, k)
+            v_pool = scatter_tokens(cache["v"], tables, positions, v)
+            new_cache = {"k": k_pool, "v": v_pool}
+            gk = gather_pages(k_pool, tables)
+            gv = gather_pages(v_pool, tables)
+            kv_pos = jnp.arange(gk.shape[1])[None, None, :]  # [1, 1, L]
+            q_pos = positions[:, :, None]  # [B, t, 1] absolute positions
+            mask = kv_pos <= q_pos  # causal AND only this row's filled slots
+            if cfg.sliding_window is not None:
+                mask = mask & _window_keep(q_pos, kv_pos, cfg.sliding_window)
+            out = _dot_attention(q, gk, gv, mask=mask)
         elif cache is not None:
             # Autoregressive decode: write this call's K/V into the static-
             # shape cache at ``offset`` and attend over the FILLED prefix
@@ -310,6 +364,7 @@ class Attention(nn.Module):
         proj = QuantDenseGeneral(
             cfg.hidden_dim, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj"
         )(out)
+        proj = _adapter_add(proj, out, "o_proj", adapters)
         return proj if new_cache is None else (proj, new_cache)
 
 
@@ -317,16 +372,17 @@ class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapters=None):
         from .quant import QuantDense
 
         cfg = self.cfg
         dense = lambda feats, name: QuantDense(
             feats, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
         )
-        gate = dense(cfg.mlp_dim, "gate_proj")(x)
-        up = dense(cfg.mlp_dim, "up_proj")(x)
-        return dense(cfg.hidden_dim, "down_proj")(nn.silu(gate) * up)
+        gate = _adapter_add(dense(cfg.mlp_dim, "gate_proj")(x), x, "gate_proj", adapters)
+        up = _adapter_add(dense(cfg.mlp_dim, "up_proj")(x), x, "up_proj", adapters)
+        h = nn.silu(gate) * up
+        return _adapter_add(dense(cfg.hidden_dim, "down_proj")(h), h, "down_proj", adapters)
 
 
 class DecoderBlock(nn.Module):
@@ -334,18 +390,28 @@ class DecoderBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None):
+    def __call__(
+        self, x, cos, sin, cache=None, offset=0, seg_info=None, decode_pad=None, attend_len=None,
+        paged=None, adapters=None,
+    ):
         cfg = self.cfg
+        # split the lora-init-shaped adapter subtree for this layer into the
+        # attn/mlp halves its submodules consume (ids ride along unchanged)
+        attn_ad = mlp_ad = None
+        if adapters is not None:
+            sub, ids = adapters
+            attn_ad = ((sub or {}).get("attn"), ids)
+            mlp_ad = ((sub or {}).get("mlp"), ids)
         new_cache = None
         if cache is not None:
             attn_out, new_cache = Attention(cfg, name="attn")(
                 RMSNorm(name="attn_norm")(x), cos, sin, cache=cache, offset=offset,
-                decode_pad=decode_pad, attend_len=attend_len,
+                decode_pad=decode_pad, attend_len=attend_len, paged=paged, adapters=attn_ad,
             )
             x = x + attn_out
         else:
             x = x + Attention(cfg, name="attn")(
-                RMSNorm(name="attn_norm")(x), cos, sin, seg_info=seg_info
+                RMSNorm(name="attn_norm")(x), cos, sin, seg_info=seg_info, adapters=attn_ad
             )
         if self.use_moe:
             from .moe import MoEConfig, MoEMLP
@@ -358,9 +424,11 @@ class DecoderBlock(nn.Module):
                 mlp_dim=cfg.mlp_dim,
                 dtype=cfg.dtype,
             )
+            # MoE blocks carry no per-request adapters (expert routing and
+            # LoRA-per-tenant compose poorly; dense layers cover serving)
             x = x + MoEMLP(moe_cfg, name="moe")(RMSNorm(name="mlp_norm")(x))
         else:
-            x = x + MLP(cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+            x = x + MLP(cfg, name="mlp")(RMSNorm(name="mlp_norm")(x), adapters=mlp_ad)
         return x if new_cache is None else (x, new_cache)
 
 
@@ -369,22 +437,43 @@ class DecoderLM(nn.Module):
 
     With ``cache``/``offset`` (see ``models/generate.py``) runs in
     autoregressive-decode mode and returns ``(logits, new_cache)``. With
-    ``segment_ids`` [B, T] int32, rows hold multiple packed examples and
-    attention never crosses segment boundaries (pair with
-    ``lm_loss(..., segment_ids=...)``)."""
+    ``cache`` holding pool pages and ``pages=(block_tables, fill)`` the
+    decode is PAGED (the serving engine's path, ``dmlcloud_tpu/serve/``):
+    each row reads/writes the pool blocks its table names at its own
+    absolute position. With ``segment_ids`` [B, T] int32, rows hold
+    multiple packed examples and attention never crosses segment
+    boundaries (pair with ``lm_loss(..., segment_ids=...)``).
+    ``adapters=(stacked_tree, ids)`` applies per-row LoRA deltas gathered
+    by adapter id inside every dense layer (multi-tenant serving; see
+    ``serve.AdapterSet``)."""
 
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(
         self, tokens, cache=None, offset=0, segment_ids=None, pad_len=None, attend_len=None,
-        return_hidden=False,
+        return_hidden=False, pages=None, adapters=None,
     ):
         cfg = self.cfg
         if pad_len is not None and cache is None:
             raise ValueError("pad_len (left-padded ragged prompts) is a decode-mode feature")
         if attend_len is not None and cache is None:
             raise ValueError("attend_len (bounded cache reads) is a decode-mode feature")
+        paged = None
+        if pages is not None:
+            # Paged decode (serving engine): ``cache`` holds the POOL pages
+            # per layer and ``pages = (block_tables [B, NB], fill [B])``
+            # says where each row's tokens live and how many are filled.
+            # Rows sit at their own absolute positions (no left-padding —
+            # ragged prompts need no pad path here), so positions derive
+            # from fill, not from a batch-wide offset.
+            if cache is None:
+                raise ValueError("pages (paged KV decode) requires the pool cache")
+            if pad_len is not None or attend_len is not None:
+                raise ValueError("pages replaces pad_len/attend_len: positions come from fill")
+            tables, fill = pages
+            positions = fill[:, None] + jnp.arange(tokens.shape[1])[None, :]
+            paged = (tables, fill, positions)
         decode_pad = None
         if pad_len is not None:
             positions = jnp.maximum(jnp.arange(tokens.shape[1])[None, :] + offset - pad_len[:, None], 0)
@@ -428,18 +517,24 @@ class DecoderLM(nn.Module):
         x = constrain(x)
         block_cls = nn.remat(DecoderBlock, prevent_cse=True) if cfg.remat else DecoderBlock
         new_cache = {} if cache is not None else None
+        adapter_tree, adapter_ids = adapters if adapters is not None else (None, None)
         for i in range(cfg.num_layers):
             use_moe = cfg.num_experts > 0 and cfg.moe_every > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             name = f"layer_{i}"
+            layer_ad = None
+            if adapter_tree is not None and adapter_tree.get(name) is not None:
+                layer_ad = (adapter_tree[name], adapter_ids)
             if cache is not None:
                 x, new_cache[name] = DecoderBlock(cfg, use_moe=use_moe, name=name)(
                     x, cos, sin, cache=cache[name], offset=offset, decode_pad=decode_pad,
-                    attend_len=attend_len,
+                    attend_len=attend_len, paged=paged, adapters=layer_ad,
                 )
                 x = constrain(x)
             else:
                 x = constrain(
-                    block_cls(cfg, use_moe=use_moe, name=name)(x, cos, sin, seg_info=seg_info)
+                    block_cls(cfg, use_moe=use_moe, name=name)(
+                        x, cos, sin, seg_info=seg_info, adapters=layer_ad
+                    )
                 )
 
         x = RMSNorm(name="final_norm")(x)
@@ -458,6 +553,8 @@ class DecoderLM(nn.Module):
             logits = QuantDense(
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
             )(x)
+            if adapter_tree is not None:
+                logits = _adapter_add(logits, x, "lm_head", (adapter_tree, adapter_ids))
         return logits if new_cache is None else (logits, new_cache)
 
 

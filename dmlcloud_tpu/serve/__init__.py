@@ -1,0 +1,48 @@
+"""dmlcloud_tpu.serve — continuous-batching inference for heavy traffic.
+
+The training stack's inference half (``models/generate.py``) runs one
+static batch per call; this package turns it into a serving engine:
+
+- :class:`KVBlockPool` (kv_pool.py): paged KV cache — fixed device pages,
+  per-sequence block tables, host free list. Memory scales with live
+  tokens; freed blocks recycle immediately.
+- :class:`Scheduler` / :class:`Request` (scheduler.py): FIFO
+  continuous-batching admission with chunked prefill — no drain barrier,
+  no starvation.
+- :class:`ServeEngine` (engine.py): the loop — bucketed decode shapes
+  (0 mid-run recompiles, TraceGuard-enforced), greedy output
+  token-identical to serial ``generate()``.
+- :class:`AdapterSet` (adapters.py): multi-tenant LoRA serving, one base
+  model + per-request adapter deltas inside the decode step.
+- :class:`ServeLedger` (ledger.py): TTFT / per-token / queue-depth
+  latency accounting, journal span kinds ``queue_wait`` / ``prefill`` /
+  ``decode_batch``.
+
+Quick start::
+
+    from dmlcloud_tpu.serve import ServeEngine
+
+    engine = ServeEngine(model, params, num_blocks=256, block_size=16,
+                         max_slots=8)
+    rid = engine.submit(prompt_tokens, max_new_tokens=64)
+    engine.run()
+    tokens = engine.output(rid)
+
+See doc/serving.md for the architecture, memory math and bench receipts.
+"""
+
+from .adapters import AdapterSet
+from .engine import ServeEngine
+from .kv_pool import KVBlockPool, PoolExhausted
+from .ledger import ServeLedger
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "AdapterSet",
+    "KVBlockPool",
+    "PoolExhausted",
+    "Request",
+    "Scheduler",
+    "ServeEngine",
+    "ServeLedger",
+]

@@ -1,0 +1,129 @@
+"""Multi-tenant LoRA serving: stacked adapters, gathered by request id.
+
+``lora_merge`` folds one adapter into the base weights — perfect for a
+single-tenant deployment, useless for serving MANY tenants from one base
+model: a merged copy per tenant multiplies the weight memory by the tenant
+count, and a batch mixing tenants has no single set of weights to run.
+
+:class:`AdapterSet` is the merge-free alternative: every tenant's LoRA
+factors are STACKED along a new leading axis (``a: [N, d_in, r]``,
+``b: [N, r, d_out]`` per adapted kernel), the decode batch carries a
+per-row adapter id, and each dense layer adds its row's own delta
+``(x @ a[id]) @ b[id]`` inside the step (``models/lora.batched_lora_delta``
+via ``transformer._adapter_add``). Cost per token is rank-r work per
+adapted kernel — the base weights stream ONCE for the whole mixed batch,
+which is the entire point of serving LoRA tenants together.
+
+Index 0 is always the implicit null adapter (zero factors, exact zero
+delta), so requests without an adapter ride the same gather. Adapters must
+be built with ``lora_init(..., in_axes=1)``: the factored application
+contracts ``a`` against the layer INPUT, so ``a`` must carry the kernel's
+first axis — the historical all-but-last split merges fine but cannot be
+applied factored (``AdapterSet`` rejects it when given ``base`` to check
+against).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from ..models.lora import LoraPair
+
+__all__ = ["AdapterSet"]
+
+
+def _is_pair(x) -> bool:
+    return isinstance(x, LoraPair)
+
+
+class AdapterSet:
+    """Stacked per-tenant LoRA adapters for batched serving.
+
+    ``adapters`` maps tenant name -> adapter tree from
+    ``lora_init(base_params, rank, in_axes=1)`` (every tenant must adapt
+    the same kernels at the same rank — they share one stacked gather).
+    ``alpha`` is the usual LoRA scale; ``b`` is pre-scaled by
+    ``alpha/rank`` at stacking time so the traced delta is two einsums and
+    nothing else. ``base`` (optional) enables the factorization check.
+    """
+
+    def __init__(
+        self,
+        adapters: Mapping[str, Any],
+        alpha: float = 16.0,
+        base: Any = None,
+    ):
+        if not adapters:
+            raise ValueError("AdapterSet needs at least one adapter")
+        self.names: list[str | None] = [None] + list(adapters)
+        self._ids = {name: i for i, name in enumerate(self.names)}
+        trees = list(adapters.values())
+        ref = jax.tree_util.tree_structure(trees[0], is_leaf=lambda x: x is None or _is_pair(x))
+        for name, tree in adapters.items():
+            if jax.tree_util.tree_structure(
+                tree, is_leaf=lambda x: x is None or _is_pair(x)
+            ) != ref:
+                raise ValueError(
+                    f"adapter {name!r} adapts a different kernel set than the others; "
+                    "all tenants must come from the same lora_init match"
+                )
+        if base is not None:
+            self._check_factorization(trees[0], base)
+
+        def stack_leaf(*pairs):
+            if pairs[0] is None:
+                return None
+            ranks = {p.a.shape[-1] for p in pairs}
+            if len(ranks) != 1:
+                raise ValueError(f"adapters disagree on rank for one kernel: {sorted(ranks)}")
+            a = jnp.stack([jnp.zeros_like(pairs[0].a)] + [p.a for p in pairs])
+            # pre-scale b by alpha/rank: the traced delta is then just
+            # (x @ a[id]) @ b[id], no runtime scale
+            b = jnp.stack(
+                [jnp.zeros_like(pairs[0].b)] + [p.b * (alpha / p.a.shape[-1]) for p in pairs]
+            )
+            return LoraPair(a=a, b=b)
+
+        self.stacked = jax.tree_util.tree_map(
+            stack_leaf, *trees, is_leaf=lambda x: x is None or _is_pair(x)
+        )
+        self.alpha = float(alpha)
+
+    @staticmethod
+    def _check_factorization(tree: Any, base: Any) -> None:
+        """``a`` must carry each base kernel's FIRST axis (in_axes=1); the
+        all-but-last factorization cannot be applied per-row."""
+
+        def check(ad, p):
+            if ad is None:
+                return
+            if ad.a.shape[0] != p.shape[0]:
+                raise ValueError(
+                    f"adapter a-factor has in-dim {ad.a.shape[0]} but the base kernel's "
+                    f"first axis is {p.shape[0]}: batched serving needs adapters built "
+                    "with lora_init(..., in_axes=1)"
+                )
+
+        jax.tree_util.tree_map(
+            check, tree, base, is_leaf=lambda x: x is None or _is_pair(x)
+        )
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def id_of(self, name: str | None) -> int:
+        """The stacked index of a tenant (None -> 0, the null adapter)."""
+        try:
+            return self._ids[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown adapter {name!r}; known: {[n for n in self.names if n]}"
+            ) from None
+
+    def pack(self, ids) -> tuple[Any, jnp.ndarray]:
+        """The ``adapters=`` argument for a decode step: the stacked tree
+        plus the per-row ids as an int32 device array."""
+        return self.stacked, jnp.asarray(ids, jnp.int32)

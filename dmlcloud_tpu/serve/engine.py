@@ -1,0 +1,353 @@
+"""The continuous-batching serving engine over the paged KV pool.
+
+One :class:`ServeEngine` owns the four pieces the module docstrings around
+it describe — the device page pool (``kv_pool``), the FIFO scheduler
+(``scheduler``), the per-request latency ledger (``ledger``) and ONE
+jitted paged decode step — and runs the serving loop:
+
+    admit waiting requests -> one prefill chunk -> one decode batch
+
+per :meth:`step`. The decode batch advances EVERY running stream by one
+token regardless of how much prefill is pending, so a long prompt never
+stalls running generations; a stream that emits EOS frees its slot and
+blocks before the next step, and the next waiting request takes them —
+continuous batching, no drain barrier.
+
+**Zero mid-run recompiles, by construction.** Every device call's shape
+signature is ``(batch_bucket, table_bucket)`` for decode and
+``(1, prefill_chunk, table_bucket)`` for prefill, with both bucket sets
+fixed at engine construction (``compile/buckets.py`` machinery — the same
+bounded-signature contract the training loop's ragged batches use). The
+jitted step is wrapped in a ``TraceGuard`` armed at exactly the bucket
+product, so a signature leak is a raised ``RetraceError`` in tests rather
+than a silent compile stall under production traffic.
+
+The decode math itself is :func:`models.generate.decode_step` — the same
+primitive ``generate``/``beam_search``/``speculative_generate`` run — with
+``pages=(block_tables, fill)`` steering it through the pool
+(``ops/paged_attention.py``), so greedy engine output is token-identical
+to serial ``generate()`` of the same prompts. ``prepare_decode_params`` is
+applied once at construction: int8 weight-only trees serve with the
+fused-dequant kernels and the off-TPU operand widen pre-paid (the PR-6
+decode win), with no per-call preparation left in the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.buckets import bucket_for, resolve_buckets
+from ..lint.traceguard import TraceGuard
+from ..telemetry import journal
+from .adapters import AdapterSet
+from .kv_pool import KVBlockPool
+from .ledger import ServeLedger
+from .scheduler import Request, Scheduler, _Sequence
+
+__all__ = ["ServeEngine"]
+
+
+def _paged_step(
+    pools, params, tables, fill, tokens, last_idx, rng, adapters,
+    *, model, temperature, top_k, top_p,
+):
+    """One traced engine step (prefill chunk or decode batch): write
+    ``tokens``' K/V through the block tables, read each row's logits at
+    ``last_idx`` and sample the next token. ``pools`` is donated — the
+    engine swaps in the returned pages (DML205: never two live copies of
+    the cache)."""
+    from ..models.generate import decode_step, sample_logits
+
+    logits, pools = decode_step(
+        model, params, tokens, pools, pages=(tables, fill), adapters=adapters
+    )
+    last = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]  # [B, V]
+    tok = sample_logits(last, rng, temperature, top_k, top_p)
+    return tok, pools
+
+
+def _pow2_buckets(limit: int) -> tuple[int, ...]:
+    """1, 2, 4, ... capped at (and always including) ``limit``."""
+    out, b = [], 1
+    while b < limit:
+        out.append(b)
+        b *= 2
+    out.append(int(limit))
+    return resolve_buckets(out)
+
+
+class ServeEngine:
+    """Continuous-batching inference over a DecoderLM (module docstring).
+
+    Construction knobs:
+
+    - ``num_blocks`` / ``block_size``: the pool geometry. The default pool
+      covers ``max_slots`` worst-case sequences — safe but dense-sized;
+      real deployments size it for the EXPECTED live tokens (the whole
+      point of paging) and let admission control do the rest.
+    - ``max_slots``: concurrent decode streams; ``batch_buckets`` /
+      ``table_buckets`` default to powers of two capped at the maxima.
+    - ``prefill_chunk``: prompt tokens processed per engine step.
+    - sampling (``temperature``/``top_k``/``top_p``/``eos_id``) is
+      engine-level: one compiled sampler for every request (greedy
+      default, ``generate()`` semantics).
+    - ``adapters``: an :class:`AdapterSet` for multi-tenant LoRA serving;
+      requests pick a tenant by name.
+    - ``guard``: ``TraceGuard`` action on a signature leak ("raise"/"warn").
+    """
+
+    def __init__(
+        self,
+        model,
+        params: Any,
+        *,
+        num_blocks: int | None = None,
+        block_size: int = 16,
+        max_slots: int = 8,
+        prefill_chunk: int = 32,
+        batch_buckets=None,
+        table_buckets=None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int = -1,
+        adapters: AdapterSet | None = None,
+        rng: jax.Array | None = None,
+        guard: str = "raise",
+        cache_dtype: Any = None,
+    ):
+        from ..models.quant import prepare_decode_params
+
+        self.model = model
+        cfg = model.cfg
+        # one-time host-side preparation: int8 kernels stay fused-quantized
+        # and the off-TPU GEMM-operand widen is pre-paid (models/quant.py)
+        self.params = prepare_decode_params(params, cfg.dtype)
+        max_table = -(-cfg.max_seq_len // block_size)
+        if num_blocks is None:
+            num_blocks = max_slots * max_table
+        self.pool = KVBlockPool.for_model(
+            cfg, num_blocks=num_blocks, block_size=block_size, dtype=cache_dtype
+        )
+        self.scheduler = Scheduler(self.pool, max_slots, prefill_chunk)
+        self.ledger = ServeLedger()
+        self.adapters = adapters
+        self.eos_id = int(eos_id)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._calls = 0
+        self._next_id = 0
+        self._done: dict[int, _Sequence] = {}
+
+        self.batch_buckets = (
+            resolve_buckets(batch_buckets) if batch_buckets else _pow2_buckets(max_slots)
+        )
+        table_cap = min(max_table, self.pool.num_blocks)
+        self.table_buckets = (
+            resolve_buckets(table_buckets) if table_buckets else _pow2_buckets(table_cap)
+        )
+        #: the engine's whole compiled-signature budget: decode is
+        #: (batch bucket x table bucket), prefill is (1, chunk) x table
+        #: bucket. TraceGuard turns any growth past this into an error.
+        self.max_signatures = (
+            len(self.batch_buckets) * len(self.table_buckets) + len(self.table_buckets)
+        )
+        # per-engine jit: jax keys its trace cache on the function OBJECT,
+        # so a fresh partial per engine gives each engine its own cache —
+        # the TraceGuard budget is then this engine's alone, not the
+        # process-wide total across every engine ever built
+        self._step_fn = TraceGuard(
+            jax.jit(
+                functools.partial(_paged_step),
+                static_argnames=("model", "temperature", "top_k", "top_p"),
+                donate_argnums=(0,),
+            ),
+            max_traces=self.max_signatures,
+            action=guard,
+            name="serve_paged_step",
+        )
+
+    # -- request lifecycle ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32, adapter: str | None = None) -> int:
+        """Queue one request; returns its id. ``prompt`` is a 1-D int32
+        token sequence (no padding — paged rows sit at their own absolute
+        positions, ragged prompts are the natural case)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if prompt.size + int(max_new_tokens) > self.model.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({self.model.cfg.max_seq_len})"
+            )
+        aid = 0
+        if adapter is not None:
+            if self.adapters is None:
+                raise ValueError("request names an adapter but the engine has no AdapterSet")
+            aid = self.adapters.id_of(adapter)
+        now = time.perf_counter()
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter, id=rid
+        )
+        seq = _Sequence(req=req, arrival=now, adapter_id=aid)
+        self.ledger.arrived(rid, now)
+        self.scheduler.submit(seq)
+        return rid
+
+    def output(self, rid: int) -> np.ndarray:
+        """The emitted tokens of a finished request."""
+        return np.asarray(self._done[rid].out, np.int32)
+
+    def results(self) -> dict[int, np.ndarray]:
+        return {rid: self.output(rid) for rid in self._done}
+
+    @property
+    def idle(self) -> bool:
+        return self.scheduler.idle
+
+    def compiled_signatures(self) -> int | None:
+        """Distinct compiled signatures so far (the TraceGuard probe)."""
+        return self._step_fn.cache_size()
+
+    # -- the serving loop ----------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: admit, one prefill chunk, one decode
+        batch. Returns whether any device work ran."""
+        now = time.perf_counter()
+        for seq in self.scheduler.admit(now):
+            self.ledger.admitted(seq.req.id, now)
+            journal.emit("queue_wait", seq.arrival, now, label=f"req{seq.req.id}",
+                         request=seq.req.id, depth=self.scheduler.depth())
+        did = False
+        seq = self.scheduler.next_prefill()
+        if seq is not None:
+            self._prefill_chunk(seq)
+            did = True
+        batch = self.scheduler.decode_batch()
+        if batch:
+            self._decode(batch)
+            did = True
+        return did
+
+    def run(self, max_steps: int | None = None) -> dict[int, np.ndarray]:
+        """Drive :meth:`step` until every submitted request finished (or
+        ``max_steps`` elapsed); returns the finished outputs."""
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.results()
+
+    def serve_trace(self, trace, clock=time.perf_counter, sleep=time.sleep) -> dict:
+        """Replay a timed request trace in real time: ``trace`` is a list
+        of ``(offset_s, prompt, max_new_tokens[, adapter])`` tuples
+        (offsets relative to the replay start). Requests are submitted
+        when the wall reaches their offset; the engine steps continuously
+        in between. Returns the ledger summary — the bench receipt's
+        engine side."""
+        pending = sorted(trace, key=lambda e: e[0])
+        t0 = clock()
+        i = 0
+        while i < len(pending) or not self.idle:
+            now = clock() - t0
+            while i < len(pending) and pending[i][0] <= now:
+                off, prompt, max_new, *rest = pending[i]
+                self.submit(prompt, max_new, adapter=rest[0] if rest else None)
+                i += 1
+            if not self.step() and i < len(pending):
+                # idle but the trace has future arrivals: nap until the next
+                sleep(min(max(pending[i][0] - (clock() - t0), 0.0), 0.001))
+        return self.ledger.summary()
+
+    # -- device calls --------------------------------------------------------
+    def _call(self, tables, fill, tokens, last_idx, ids):
+        self._calls += 1
+        rng = jax.random.fold_in(self._rng, self._calls)
+        adapters = None
+        if self.adapters is not None:
+            adapters = (self.adapters.stacked, jnp.asarray(ids, jnp.int32))
+        tok, new_pools = self._step_fn(
+            self.pool.pools, self.params,
+            jnp.asarray(tables, jnp.int32), jnp.asarray(fill, jnp.int32),
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
+            rng, adapters,
+            model=self.model, temperature=self._temperature,
+            top_k=self._top_k, top_p=self._top_p,
+        )
+        self.pool.swap(new_pools)
+        return np.asarray(tok)  # the per-step host sync: tokens ARE the output
+
+    def _table_rows(self, seqs, nb: int) -> np.ndarray:
+        rows = np.full((len(seqs), nb), self.pool.sentinel, np.int32)
+        for i, s in enumerate(seqs):
+            blocks = s.blocks[: min(len(s.blocks), nb)]
+            rows[i, : len(blocks)] = blocks
+        return rows
+
+    def _prefill_chunk(self, seq) -> None:
+        c = self.scheduler.prefill_chunk
+        n = min(c, seq.prompt_len - seq.fill)
+        tokens = np.zeros((1, c), np.int32)
+        tokens[0, :n] = seq.req.prompt[seq.fill : seq.fill + n]
+        nb = bucket_for(self.pool.blocks_for(seq.fill + n), self.table_buckets)
+        final = seq.fill + n >= seq.prompt_len
+        t0 = journal.now()
+        tok = self._call(
+            self._table_rows([seq], nb), np.asarray([seq.fill], np.int32), tokens,
+            np.asarray([n - 1], np.int32), [seq.adapter_id],
+        )
+        seq.fill += n
+        journal.emit("prefill", t0, label=f"req{seq.req.id}", request=seq.req.id,
+                     chunk=n, fill=seq.fill, blocks=nb)
+        if final:
+            # the last real prompt position's logits ARE the first token —
+            # time-to-first-token ends here, before any decode step
+            now = time.perf_counter()
+            self.ledger.first_token(seq.req.id, now)
+            self.scheduler.prefill_done(seq)
+            self._emit(seq, int(tok[0]), now)
+
+    def _decode(self, batch) -> None:
+        bb = bucket_for(len(batch), self.batch_buckets)
+        needed = max(s.needed_blocks(self.pool.block_size) for s in batch)
+        nb = bucket_for(needed, self.table_buckets)
+        tables = np.full((bb, nb), self.pool.sentinel, np.int32)
+        tables[: len(batch)] = self._table_rows(batch, nb)
+        fill = np.zeros(bb, np.int32)
+        tokens = np.zeros((bb, 1), np.int32)
+        ids = np.zeros(bb, np.int64)
+        for i, s in enumerate(batch):
+            fill[i] = s.fill
+            tokens[i, 0] = s.last_token
+            ids[i] = s.adapter_id
+        t0 = journal.now()
+        tok = self._call(tables, fill, tokens, np.zeros(bb, np.int32), ids)
+        now = time.perf_counter()
+        journal.emit("decode_batch", t0, label=f"b{bb}", active=len(batch),
+                     bucket=bb, blocks=nb)
+        self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        for i, s in enumerate(batch):
+            s.fill += 1  # the fed token's K/V landed at its position
+            self._emit(s, int(tok[i]), now)
+
+    def _emit(self, seq, tok: int, now: float) -> None:
+        seq.out.append(tok)
+        self.ledger.token(seq.req.id)
+        if tok == self.eos_id or len(seq.out) >= seq.req.max_new_tokens:
+            self.scheduler.finish(seq, now)
+            self.ledger.finished(seq.req.id, now)
+            self._done[seq.req.id] = seq
+        else:
+            seq.last_token = tok

@@ -1,0 +1,93 @@
+"""Per-request latency ledger: TTFT, per-token pace, queue depth.
+
+The training side's goodput ledger decomposes epochs; serving needs the
+request-centric twin. The engine records, per request: arrival ->
+admission (queue wait), admission -> first emitted token (prefill +
+scheduling), token count and completion — all ``time.perf_counter``
+readings (the journal's clock discipline; wall clock never enters a
+duration). ``summary()`` reduces them to the numbers a capacity planner
+asks for: p50/p99 TTFT, mean queue wait, served tokens/s over the busy
+window, and the queue-depth profile the engine samples once per step.
+
+The ledger is pure host bookkeeping — O(1) dict/list appends per event,
+no device interaction — and rides next to the span journal: every record
+here corresponds to ``queue_wait`` / ``prefill`` / ``decode_batch`` spans
+when telemetry is armed, so a Perfetto timeline and this summary never
+disagree about what the engine did.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ServeLedger"]
+
+
+def _pct(values, q):
+    return float(np.percentile(np.asarray(values, np.float64), q)) if values else None
+
+
+class ServeLedger:
+    """Accumulates per-request timing records and step-level samples."""
+
+    def __init__(self):
+        self.records: dict[int, dict] = {}
+        self.queue_depths: list[int] = []
+        self.batch_sizes: list[int] = []
+        self.decode_steps = 0
+
+    # -- per-request events --------------------------------------------------
+    def arrived(self, rid: int, now: float) -> None:
+        self.records[rid] = {"arrival": now, "tokens": 0}
+
+    def admitted(self, rid: int, now: float) -> None:
+        self.records[rid]["admitted"] = now
+
+    def first_token(self, rid: int, now: float) -> None:
+        self.records[rid]["first_token"] = now
+
+    def token(self, rid: int) -> None:
+        self.records[rid]["tokens"] += 1
+
+    def finished(self, rid: int, now: float) -> None:
+        self.records[rid]["finished"] = now
+
+    # -- per-step samples ----------------------------------------------------
+    def step_sample(self, queue_depth: int, batch_size: int) -> None:
+        self.decode_steps += 1
+        self.queue_depths.append(int(queue_depth))
+        self.batch_sizes.append(int(batch_size))
+
+    # -- reduction -----------------------------------------------------------
+    def ttfts(self) -> list[float]:
+        return [
+            r["first_token"] - r["arrival"]
+            for r in self.records.values()
+            if "first_token" in r
+        ]
+
+    def summary(self) -> dict:
+        """The serving scorecard. ``tokens_per_sec`` covers the busy window
+        (first arrival -> last completion) — the end-to-end number a trace
+        replay compares, queueing included."""
+        done = [r for r in self.records.values() if "finished" in r]
+        ttft = self.ttfts()
+        waits = [r["admitted"] - r["arrival"] for r in self.records.values() if "admitted" in r]
+        total_tokens = sum(r["tokens"] for r in self.records.values())
+        span = None
+        if done and self.records:
+            t0 = min(r["arrival"] for r in self.records.values())
+            t1 = max(r["finished"] for r in done)
+            span = max(t1 - t0, 1e-9)
+        return {
+            "requests": len(self.records),
+            "completed": len(done),
+            "total_tokens": total_tokens,
+            "tokens_per_sec": round(total_tokens / span, 1) if span else None,
+            "p50_ttft_s": _pct(ttft, 50),
+            "p99_ttft_s": _pct(ttft, 99),
+            "mean_queue_wait_s": float(np.mean(waits)) if waits else None,
+            "max_queue_depth": max(self.queue_depths, default=0),
+            "mean_batch_size": float(np.mean(self.batch_sizes)) if self.batch_sizes else None,
+            "decode_steps": self.decode_steps,
+        }

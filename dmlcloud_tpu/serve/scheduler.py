@@ -1,0 +1,167 @@
+"""The continuous-batching scheduler: FIFO admission, no drain barrier.
+
+The scheduling contract, in order of importance:
+
+1. **No starvation.** Admission is STRICT FIFO with full reservation: the
+   head of the waiting queue is admitted the moment a decode slot opens
+   AND the pool can cover its worst case (``ceil((prompt + max_new) /
+   block_size)`` blocks); nobody behind it may jump the queue even if they
+   would fit. Head-of-line blocking costs a little utilisation, but it
+   makes progress provable — every admitted request holds all the blocks
+   it can ever need (it cannot deadlock mid-decode), every finished
+   request frees a slot and blocks, so the head always eventually admits.
+   Property-tested over randomized traces in tests/test_serve.py.
+2. **No drain barrier.** A sequence that emits EOS (or hits its token
+   budget) releases its slot and blocks immediately; the next waiting
+   request joins the running batch at the next step. Dense static
+   batching — where finished rows burn slots until the whole batch
+   drains — is exactly what this module exists to delete.
+3. **Prefill never stalls decode.** A newly admitted request's prompt is
+   processed in ``prefill_chunk``-token chunks, at most one chunk per
+   engine step, interleaved with the decode batch of the already-running
+   streams — a 100k-token prompt delays running streams by one chunk's
+   latency per step, never by its whole prefill.
+
+The scheduler is pure host-side bookkeeping (deques of :class:`_Sequence`
+records); the engine owns every device interaction.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from .kv_pool import KVBlockPool
+
+__all__ = ["Request", "Scheduler"]
+
+
+@dataclass(eq=False)  # identity comparison: prompt arrays don't define ==
+class Request:
+    """One generation request. ``prompt`` is a 1-D int32 token array;
+    ``adapter`` names a tenant adapter in the engine's ``AdapterSet``
+    (None = base model)."""
+
+    prompt: Any
+    max_new_tokens: int = 32
+    adapter: str | None = None
+    id: int = -1  # assigned by the engine at submit
+
+
+@dataclass(eq=False)  # identity comparison (deque/list membership tests)
+class _Sequence:
+    """Runtime state of one admitted request (engine-internal)."""
+
+    req: Request
+    arrival: float
+    blocks: list[int] = field(default_factory=list)
+    fill: int = 0  # cache slots written (prefill progress, then decode)
+    out: list[int] = field(default_factory=list)  # emitted tokens
+    last_token: int = 0  # next decode step's input
+    admitted: float | None = None
+    first_token: float | None = None
+    finished: float | None = None
+    adapter_id: int = 0
+
+    @property
+    def prompt_len(self) -> int:
+        return int(np.shape(self.req.prompt)[0])
+
+    @property
+    def prefilled(self) -> bool:
+        return self.fill >= self.prompt_len
+
+    def needed_blocks(self, block_size: int) -> int:
+        """Blocks covering the next step's reads AND write (position
+        ``fill``), i.e. the live prefix only — what the decode batch
+        actually gathers, not the full reservation."""
+        return -(-(self.fill + 1) // block_size)
+
+
+class Scheduler:
+    """FIFO continuous-batching admission over a :class:`KVBlockPool`."""
+
+    def __init__(self, pool: KVBlockPool, max_slots: int, prefill_chunk: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.pool = pool
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.waiting: collections.deque[_Sequence] = collections.deque()
+        self.prefilling: collections.deque[_Sequence] = collections.deque()
+        self.running: list[_Sequence] = []
+
+    # -- queue state ---------------------------------------------------------
+    @property
+    def active(self) -> int:
+        """Admitted-but-unfinished sequences (holding a decode slot)."""
+        return len(self.prefilling) + len(self.running)
+
+    @property
+    def idle(self) -> bool:
+        return not (self.waiting or self.prefilling or self.running)
+
+    def depth(self) -> int:
+        """Requests waiting for admission (the queue-depth observable)."""
+        return len(self.waiting)
+
+    # -- lifecycle -----------------------------------------------------------
+    def submit(self, seq: _Sequence) -> None:
+        """Queue a request. Rejects one that could NEVER be admitted —
+        a worst case larger than the whole pool would starve the queue
+        behind it forever under strict FIFO."""
+        need = self.pool.blocks_for(seq.prompt_len + seq.req.max_new_tokens)
+        if need > self.pool.num_blocks:
+            raise ValueError(
+                f"request needs {need} blocks worst-case but the pool only has "
+                f"{self.pool.num_blocks}; raise num_blocks or lower max_new_tokens"
+            )
+        if seq.req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.waiting.append(seq)
+
+    def admit(self, now: float) -> list[_Sequence]:
+        """Admit from the head of the waiting queue while a slot AND the
+        head's full reservation fit. Returns the newly admitted sequences
+        (blocks already allocated, prefill pending)."""
+        admitted = []
+        while self.waiting and self.active < self.max_slots:
+            head = self.waiting[0]
+            need = self.pool.blocks_for(head.prompt_len + head.req.max_new_tokens)
+            if need > self.pool.num_free:
+                break  # strict FIFO: nobody may overtake the head
+            self.waiting.popleft()
+            head.blocks = self.pool.alloc(need)
+            head.admitted = now
+            self.prefilling.append(head)
+            admitted.append(head)
+        return admitted
+
+    def next_prefill(self) -> _Sequence | None:
+        """The sequence owed the next prefill chunk (oldest first)."""
+        return self.prefilling[0] if self.prefilling else None
+
+    def prefill_done(self, seq: _Sequence) -> None:
+        """Move a fully-prefilled sequence into the decode batch."""
+        self.prefilling.remove(seq)
+        self.running.append(seq)
+
+    def finish(self, seq: _Sequence, now: float) -> None:
+        """Release a finished sequence's slot and blocks IMMEDIATELY —
+        the no-drain-barrier property lives here."""
+        if seq in self.running:
+            self.running.remove(seq)
+        elif seq in self.prefilling:
+            self.prefilling.remove(seq)
+        self.pool.free(seq.blocks)
+        seq.blocks = []
+        seq.finished = now
+
+    def decode_batch(self) -> list[_Sequence]:
+        """The sequences decoding this step (stable submission order)."""
+        return list(self.running)

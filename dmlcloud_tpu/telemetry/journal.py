@@ -70,6 +70,9 @@ SPAN_KINDS = frozenset(
         "host_stall",  # any other accounted host block (StallTimer)
         "watchdog",  # forensics dump events
         "sanitizer",  # runtime sanitizer violations (lint/sanitize.py)
+        "queue_wait",  # serving: request arrival -> admission (serve/)
+        "prefill",  # serving: one chunked-prefill device call
+        "decode_batch",  # serving: one continuous-batching decode step
     }
 )
 

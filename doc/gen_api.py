@@ -46,6 +46,12 @@ MODULES = [
     ("dmlcloud_tpu.models.lora", "LoRA adapter finetuning (init/merge/export)."),
     ("dmlcloud_tpu.models.quant", "Weight-only int8 quantization for decode."),
     ("dmlcloud_tpu.models.speculative", "Speculative decoding: exact greedy or exact sampled, draft-verified."),
+    ("dmlcloud_tpu.ops.paged_attention", "Paged KV gather/scatter indexing for the serving engine."),
+    ("dmlcloud_tpu.serve.kv_pool", "Paged KV-cache block pool: device pages, host free list."),
+    ("dmlcloud_tpu.serve.scheduler", "Continuous-batching FIFO scheduler with chunked prefill."),
+    ("dmlcloud_tpu.serve.engine", "ServeEngine: the continuous-batching serving loop."),
+    ("dmlcloud_tpu.serve.adapters", "AdapterSet: multi-tenant LoRA serving, merge-free."),
+    ("dmlcloud_tpu.serve.ledger", "Per-request latency ledger (TTFT, queue depth)."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),
     ("dmlcloud_tpu.data.device", "Host-to-device batch transfer."),

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Serving A/B receipt: the continuous-batching engine (dmlcloud_tpu/serve/)
+vs serial ``generate()`` calls on the pinned CPU-smoke Poisson request
+trace (doc/serving.md):
+
+- tokens/s over the busy window for both arms (the engine batches up to
+  ``max_slots`` decode streams; serial services one request at a time)
+- p50/p99 time-to-first-token under the same arrival process (serial TTFT
+  is honest: one compiled program emits nothing until it returns)
+- greedy token-identity of the engine against serial generate, and the
+  engine's compiled-signature count against its TraceGuard budget
+
+Thin CLI over ``bench.bench_serve`` (which runs ``bench.py --serve-child``
+CPU-pinned) so the committed receipt and an interactive investigation run
+the exact same workload. The receipt's flat ``gate`` section is what
+``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares.
+
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_pr08.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    args = parser.parse_args()
+
+    from bench import bench_serve
+
+    results = bench_serve()
+    if results is None:
+        print("serve bench failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# CI perf gate, two suites (doc/performance.md §"Kernel receipts",
-# doc/elasticity.md):
+# CI perf gate, three suites (doc/performance.md §"Kernel receipts",
+# doc/elasticity.md, doc/serving.md):
 #
 #   kernels  current kernel ratios (flash fwd / fwd+bwd vs unfused,
 #            speculative speedup + accept rate, int8 decode) and goodput
@@ -9,14 +9,17 @@
 #            on 2) vs the last committed BENCH_elastic_*.json — exact
 #            resume (0 replayed steps), save-on-preempt latency,
 #            time-to-resume; a missing metric FAILS
+#   serve    the continuous-batching serving A/B (Poisson trace, engine vs
+#            serial generate) vs the last committed BENCH_serve_*.json —
+#            tokens/s speedup, engine tokens/s, p99 TTFT (lower-is-better)
 #
 # Runs after the lint gate in the CI flow:
 #
 #     scripts/lint_gate.sh && scripts/perf_gate.sh
 #
-# Usage: scripts/perf_gate.sh [extra gate args, e.g. --suite kernels
+# Usage: scripts/perf_gate.sh [extra gate args, e.g. --suite serve
 #        --tolerance 0.2 --baseline BENCH_kernels_pr06.json --current f.json]
-# With no args BOTH suites run (each measures fresh in a CPU-pinned child —
+# With no args ALL suites run (each measures fresh in a CPU-pinned child —
 # a few minutes); exit 0 pass, 1 regression, 2 could-not-measure.
 set -euo pipefail
 cd "$(dirname "$0")/.."

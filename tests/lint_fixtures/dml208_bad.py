@@ -1,0 +1,54 @@
+"""DML208 bad fixture: full KV-cache allocation inside a request/serve
+loop.
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax.numpy as jnp
+
+from dmlcloud_tpu.models import generate as gen
+from dmlcloud_tpu.models.generate import init_cache
+from dmlcloud_tpu.serve import KVBlockPool
+
+
+def serve_requests(model, params, requests):
+    outputs = []
+    for req in requests:
+        cache = init_cache(model.cfg, 1, model.cfg.max_seq_len)  # BAD: per-request realloc
+        outputs.append(decode(model, params, req, cache))
+    return outputs
+
+
+def serve_requests_via_module(model, params, requests):
+    outputs = []
+    for req in requests:
+        cache = gen.init_cache(model.cfg, 1, 2048)  # BAD: aliased import, same churn
+        outputs.append(decode(model, params, req, cache))
+    return outputs
+
+
+def rebuild_pool_per_batch(cfg, batches):
+    done = []
+    while batches:
+        batch = batches.pop()
+        pool = KVBlockPool(cfg.num_layers, cfg.kv_heads, cfg.head_dim,
+                           num_blocks=64, block_size=16)  # BAD: pool rebuilt per batch
+        done.append(run(batch, pool))
+    return done
+
+
+def aliased_allocator(model, params, requests):
+    alloc = init_cache
+    outs = []
+    for req in requests:
+        cache = alloc(model.cfg, 1, 1024)  # BAD: assignment alias resolves to init_cache
+        outs.append(decode(model, params, req, cache))
+    return outs
+
+
+def decode(model, params, req, cache):
+    return cache
+
+
+def run(batch, pool):
+    return batch

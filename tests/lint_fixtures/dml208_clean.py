@@ -1,0 +1,53 @@
+"""DML208 clean fixture: cache allocation hoisted out of the serve loop
+(or inside a function the loop merely defines).
+
+Static lint corpus — never imported or executed.
+"""
+
+import jax.numpy as jnp
+
+from dmlcloud_tpu.models.generate import init_cache, rewind_cache
+from dmlcloud_tpu.serve import KVBlockPool
+
+
+def serve_requests(model, params, requests):
+    # allocated ONCE, rewound between requests — the dense-cache reuse idiom
+    cache = init_cache(model.cfg, 1, model.cfg.max_seq_len)
+    outputs = []
+    for req in requests:
+        cache = rewind_cache(cache, 0)
+        outputs.append(decode(model, params, req, cache))
+    return outputs
+
+
+def serve_with_pool(cfg, requests):
+    # the pool is the loop-free allocation: blocks recycle per request
+    pool = KVBlockPool(cfg.num_layers, cfg.kv_heads, cfg.head_dim,
+                       num_blocks=128, block_size=16)
+    done = []
+    while requests:
+        done.append(run(requests.pop(), pool))
+    return done
+
+
+def loop_defines_helper(model, params, requests):
+    # a def inside the loop body runs at CALL time, not per iteration
+    handlers = []
+    for req in requests:
+        def handler(r=req):
+            cache = init_cache(model.cfg, 1, 256)
+            return decode(model, params, r, cache)
+        handlers.append(handler)
+    return handlers
+
+
+def module_level_is_fine(model):
+    return init_cache(model.cfg, 4, 512)
+
+
+def decode(model, params, req, cache):
+    return cache
+
+
+def run(batch, pool):
+    return batch

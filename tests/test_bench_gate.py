@@ -198,6 +198,85 @@ def test_gate_main_elastic_suite_with_explicit_files(tmp_path):
     assert gate_main(["--gate", "--suite", "nope"]) == 2
 
 
+# ------------------------------------------------------------- serve suite
+
+SERVE_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "token_identical_to_serial": True,
+    "gate": {
+        "serve_tokens_per_sec_speedup": 3.0,
+        "serve_engine_tokens_per_sec": 300.0,
+        "serve_p99_ttft_s": 1.5,
+    },
+}
+
+
+def test_serve_gate_passes_against_itself(tmp_path):
+    base = _write(tmp_path, "BENCH_serve_base.json", SERVE_RECEIPT)
+    assert run_gate(base, current=dict(SERVE_RECEIPT)) == 0
+
+
+def test_serve_gate_fails_against_doctored_regression(tmp_path, capsys):
+    """An engine that stopped beating serial generate (speedup collapses
+    below the committed number) FAILS the gate."""
+    doctored = json.loads(json.dumps(SERVE_RECEIPT))
+    doctored["gate"]["serve_tokens_per_sec_speedup"] = 0.9  # engine lost its win
+    doctored["gate"]["serve_engine_tokens_per_sec"] = 90.0
+    base = _write(tmp_path, "BENCH_serve_base.json", SERVE_RECEIPT)
+    cur = _write(tmp_path, "doctored.json", doctored)
+    assert run_gate(base, current=cur) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "serve_tokens_per_sec_speedup" in out
+
+
+def test_serve_p99_ttft_is_lower_is_better(tmp_path, capsys):
+    """TTFT is a latency: growth past the wide latency tolerance fails,
+    shrinking (an improvement) always passes."""
+    slow = json.loads(json.dumps(SERVE_RECEIPT))
+    slow["gate"]["serve_p99_ttft_s"] = 1.5 * 2.5  # > 2x baseline
+    base = _write(tmp_path, "BENCH_serve_base.json", SERVE_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "serve_p99_ttft_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(SERVE_RECEIPT))
+    fast["gate"]["serve_p99_ttft_s"] = 0.1
+    assert run_gate(base, current=fast) == 0
+
+
+def test_serve_missing_metric_fails(tmp_path, capsys):
+    """PR-6 semantics: a serve metric that silently vanishes is a FAIL."""
+    current = {"gate": {"serve_tokens_per_sec_speedup": 3.0}}
+    base = _write(tmp_path, "BENCH_serve_base.json", SERVE_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_serve_suite_with_explicit_files(tmp_path):
+    base = _write(tmp_path, "BENCH_serve_base.json", SERVE_RECEIPT)
+    cur = _write(tmp_path, "cur.json", SERVE_RECEIPT)
+    assert gate_main(["--gate", "--suite", "serve", "--baseline", base, "--current", cur]) == 0
+
+
+def test_committed_serve_receipt_satisfies_the_gate():
+    """The committed PR 8 receipt must pass its own gate, beat serial
+    generate by the acceptance floor (1.5x tokens/s), report p99 TTFT,
+    stay inside its TraceGuard signature budget, decode token-identically
+    to serial generate, and be honest about where it ran."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_pr08.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    assert receipt["gate"]["serve_tokens_per_sec_speedup"] >= 1.5
+    assert receipt["gate"]["serve_p99_ttft_s"] > 0
+    assert receipt["serial"]["p99_ttft_s"] > 0
+    assert receipt["token_identical_to_serial"] is True
+    assert receipt["value_source"] == "cpu_smoke"
+    eng = receipt["engine"]
+    assert eng["completed"] == receipt["config"]["n_requests"]
+    assert eng["compiled_signatures"] <= eng["max_signatures"]
+
+
 def test_committed_elastic_receipt_satisfies_the_gate():
     """The committed PR 7 receipt must pass its own gate and certify exact
     resumption: 0 steps replayed, a resumable preemption verdict."""

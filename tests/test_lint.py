@@ -43,6 +43,7 @@ BAD_EXPECT = {
     "DML205": 3,
     "DML206": 3,
     "DML207": 3,
+    "DML208": 4,
     "DML301": 2,
     "DML302": 2,
 }

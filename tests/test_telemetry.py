@@ -43,6 +43,8 @@ V1_KINDS = {
     "run", "stage", "epoch", "step_dispatch", "data_wait", "h2d",
     "metric_readback", "checkpoint", "barrier", "compile", "host_stall",
     "watchdog", "sanitizer",
+    # serving engine (PR 8): queue wait, chunked prefill, decode batches
+    "queue_wait", "prefill", "decode_batch",
 }
 
 #: Core fields every v1 record carries, with their types.
