@@ -1136,6 +1136,222 @@ def bench_serve(timeout_s: int = 1200) -> dict | None:
     return None
 
 
+# ------------------------------------------------------- data plane bench
+
+_DATA_MARKER = "DATA_BENCH_RESULTS "
+
+#: the CPU-smoke data-plane A/B config — pinned so receipts stay
+#: comparable. A ragged corpus with lognormal document lengths (median 64
+#: tokens under a 256-slot row: pad-to-max wastes ~3/4 of every batch —
+#: the regime packing exists for), drawn as a deterministic weighted mix
+#: of two sources so the receipt exercises the WHOLE streaming plane
+#: (mix -> pack_stream -> batch -> TrainValStage). fp32 2-layer decoder:
+#: big enough that the step dominates Python dispatch, small enough that
+#: the A/B finishes in CI time.
+_DATA_CFG = dict(
+    vocab=512, layers=2, heads=4, kv=2, head_dim=32, hidden=128, mlp=256,
+    seq_len=256, batch=8, n_docs=768, len_median=64.0, len_sigma=0.6,
+    min_len=4, chunk_docs=192, mix_weights=(3.0, 1.0), seed=0, epochs=2,
+)
+
+
+def _data_corpus():
+    """The pinned ragged corpus, pre-split into the two mix sources: token
+    ids are drawn from [1, vocab) so id 0 stays the pad id."""
+    c = _DATA_CFG
+    rs = np.random.RandomState(c["seed"])
+    lengths = np.clip(
+        np.round(rs.lognormal(np.log(c["len_median"]), c["len_sigma"], c["n_docs"])),
+        c["min_len"], c["seq_len"],
+    ).astype(np.int64)
+    docs = [rs.randint(1, c["vocab"], size=int(n)).astype(np.int32) for n in lengths]
+    half = len(docs) // 2
+    return docs[:half], docs[half:]
+
+
+def _data_mix_stream():
+    """mix(sources, weights, seed): the deterministic weighted document
+    stream BOTH arms consume — only the batching differs."""
+    from dmlcloud_tpu.data import DataPipeline
+
+    c = _DATA_CFG
+    a, b = _data_corpus()
+    return DataPipeline.mix(
+        [DataPipeline.from_source(a), DataPipeline.from_source(b)],
+        weights=c["mix_weights"], seed=c["seed"],
+    )
+
+
+def _data_arm(packed: bool, stats=None) -> dict:
+    """One arm of the A/B through the real TrainValStage train step: the
+    mixed document stream either pad-to-max (one document per row,
+    ``segment_ids`` marking the pad slots — the correct-loss baseline) or
+    streamed through ``pack_stream``. Both arms train the same fp32
+    decoder with the segment-masked loss; telemetry arms the goodput
+    ledger, so data_wait and pad_fraction come from the same accounting
+    production runs use. Epoch 1 absorbs any warmup; the reported numbers
+    come from epoch 2's tracker metrics."""
+    import optax
+
+    import dmlcloud_tpu as dml
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
+
+    c = _DATA_CFG
+    seq_len, batch = c["seq_len"], c["batch"]
+
+    def pad_row(doc):
+        tokens = np.zeros(seq_len, np.int32)
+        segs = np.zeros(seq_len, np.int32)
+        tokens[: doc.size] = doc
+        segs[: doc.size] = 1
+        return {"tokens": tokens, "segment_ids": segs}
+
+    def collate(rows):
+        return {k: np.stack([r[k] for r in rows]) for k in ("tokens", "segment_ids")}
+
+    stream = _data_mix_stream()
+    if packed:
+        stream = stream.pack_stream(seq_len, chunk_docs=c["chunk_docs"], stats=stats)
+    else:
+        stream = stream.map(pad_row)
+    ds = stream.batch(batch, drop_remainder=True, collate=collate)
+
+    class DataStage(dml.TrainValStage):
+        def pre_stage(self):
+            cfg = TransformerConfig(
+                vocab_size=c["vocab"], num_layers=c["layers"], num_heads=c["heads"],
+                num_kv_heads=c["kv"], head_dim=c["head_dim"], hidden_dim=c["hidden"],
+                mlp_dim=c["mlp"], max_seq_len=seq_len, dtype=jnp.float32,
+            )
+            self.pipeline.register_model(
+                "lm", DecoderLM(cfg),
+                init_args=(np.zeros((1, 8), np.int32),), verbose=False,
+            )
+            self.pipeline.register_optimizer("sgd", optax.sgd(1e-3))
+            self.pipeline.register_dataset("train", ds, verbose=False)
+
+        def step(self, state, batch):
+            logits = state.apply_fn(
+                {"params": state.params}, batch["tokens"], segment_ids=batch["segment_ids"]
+            )
+            return lm_loss(logits, batch["tokens"], segment_ids=batch["segment_ids"])
+
+        def val_epoch(self):  # throughput bench: train only
+            pass
+
+        def precompile(self):
+            # AOT the one fixed-shape signature up front: misc/recompiles
+            # then counts every mid-run XLA compile (0 is the contract —
+            # both arms emit fixed [batch, seq_len] rows by construction)
+            return True
+
+        def log_every(self):
+            return 0
+
+    pipeline = dml.TrainingPipeline(name=f"bench-data-{'packed' if packed else 'pad'}", telemetry=True)
+    pipeline.append_stage(DataStage(), max_epochs=c["epochs"], name="stage")
+    pipeline.run()
+    tracker = pipeline.tracker
+
+    def last(name):
+        if name in tracker and tracker[name] and tracker[name][-1] is not None:
+            return float(tracker[name][-1])
+        return None
+
+    steps = int(last("misc/worker_train_batches") or 0)
+    step_ms = last("misc/train_step_avg_ms") or 0.0
+    pad_frac = last("misc/pad_fraction") or 0.0
+    slots = steps * batch * seq_len
+    real_tokens = slots * (1.0 - pad_frac)
+    elapsed_s = steps * step_ms / 1e3
+    recompiles = sum(int(v or 0) for v in tracker["misc/recompiles"]) if "misc/recompiles" in tracker else None
+    return {
+        "steps_per_epoch": steps,
+        "step_avg_ms": round(step_ms, 3),
+        "pad_fraction": round(pad_frac, 4),
+        "real_tokens_per_epoch": int(real_tokens),
+        "tokens_per_sec": round(real_tokens / elapsed_s, 1) if elapsed_s > 0 else None,
+        "data_wait_s": round((last("misc/data_wait_ms") or 0.0) / 1e3, 4),
+        "goodput_frac": last("misc/goodput"),
+        "recompiles": recompiles,
+    }
+
+
+def data_child_main():
+    """A/B the streaming packed data plane against pad-to-max on the pinned
+    ragged corpus (CPU-pinned child); prints one marker line of JSON — the
+    source of ``BENCH_data_*.json`` and of ``bench.py --gate --suite
+    data``'s current numbers."""
+    jax.config.update("jax_platforms", "cpu")
+    from dmlcloud_tpu.data import PackStats
+    from dmlcloud_tpu.native import pack as native_pack
+
+    c = _DATA_CFG
+    # pad arm FIRST so in-process warm-up bias favors the baseline — a
+    # packed win is then conservative, never an ordering artifact
+    pad = _data_arm(packed=False)
+    stats = PackStats()
+    packed = _data_arm(packed=True, stats=stats)
+    packed["pack"] = stats.as_dict()
+
+    speedup = (
+        round(packed["tokens_per_sec"] / pad["tokens_per_sec"], 3)
+        if packed["tokens_per_sec"] and pad["tokens_per_sec"]
+        else None
+    )
+    reclaimed = round(pad["pad_fraction"] - packed["pad_fraction"], 4)
+    zero_recompiles = float(
+        (pad["recompiles"] or 0) == 0 and (packed["recompiles"] or 0) == 0
+    )
+    results = {
+        "workload": {
+            **{k: (list(v) if isinstance(v, tuple) else v) for k, v in c.items()},
+            "corpus": "lognormal doc lengths, pinned seed, 2-source weighted mix",
+            "native_packer": native_pack.available(),
+        },
+        "value_source": "cpu_smoke",
+        "pad_to_max": pad,
+        "packed_stream": packed,
+        "packed_vs_pad_tokens_per_sec": speedup,
+        # wasted-token fraction before vs after: the reclaimed padding
+        "padding_waste_reclaimed": reclaimed,
+        # the flat, schema-stable section the perf gate compares
+        "gate": {
+            "data_packed_speedup_vs_pad": speedup,
+            "data_packed_tokens_per_sec": packed["tokens_per_sec"],
+            "data_padding_waste_reclaimed": reclaimed,
+            "data_zero_recompiles": zero_recompiles,
+            "data_wait_s": packed["data_wait_s"],
+        },
+    }
+    print(_DATA_MARKER + json.dumps(results), flush=True)
+
+
+def bench_data(timeout_s: int = 900) -> dict | None:
+    """Run the data-plane A/B in a CPU-pinned child; returns its results
+    dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--data-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_DATA_MARKER):
+            try:
+                return json.loads(line[len(_DATA_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 # --------------------------------------------------------------- perf gate
 
 #: relative drop in a gate metric that fails the gate (15%: comfortably
@@ -1150,7 +1366,12 @@ _GATE_GOODPUT_KEYS = ("goodput_frac",)
 #: gate metrics where SMALLER is better (the elastic drill's latencies);
 #: everything else is a speedup/ratio where bigger is better
 _GATE_LOWER_IS_BETTER = frozenset(
-    {"elastic_save_on_preempt_latency_s", "elastic_time_to_resume_s", "serve_p99_ttft_s"}
+    {
+        "elastic_save_on_preempt_latency_s",
+        "elastic_time_to_resume_s",
+        "serve_p99_ttft_s",
+        "data_wait_s",
+    }
 )
 
 #: relative GROWTH allowed for the lower-is-better latency metrics (100%:
@@ -1245,18 +1466,22 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
 
 
 def gate_main(argv: list) -> int:
-    """``bench.py --gate [--suite kernels|elastic|serve|all] [--baseline
-    B.json] [--current C.json] [--tolerance 0.15]`` — CI regression gate
-    over the committed receipts (scripts/perf_gate.sh wires it into the
-    lint-gate flow). The ``kernels`` suite (default) measures the kernel
-    A/Bs; the ``elastic`` suite runs the preemption drill and compares its
-    metrics against the last committed ``BENCH_elastic_*.json`` (exact
-    resume, save-on-preempt latency, time-to-resume); the ``serve`` suite
-    replays the Poisson serving A/B against the last committed
-    ``BENCH_serve_*.json`` (tokens/s speedup vs serial generate, absolute
-    engine tokens/s, p99 TTFT as a lower-is-better latency). A missing
-    metric FAILS in every suite; ``all`` chains them and fails on the
-    worst."""
+    """``bench.py --gate [--suite kernels|elastic|serve|data|all]
+    [--baseline B.json] [--current C.json] [--tolerance 0.15]`` — CI
+    regression gate over the committed receipts (scripts/perf_gate.sh
+    wires it into the lint-gate flow). The ``kernels`` suite (default)
+    measures the kernel A/Bs; the ``elastic`` suite runs the preemption
+    drill and compares its metrics against the last committed
+    ``BENCH_elastic_*.json`` (exact resume, save-on-preempt latency,
+    time-to-resume); the ``serve`` suite replays the Poisson serving A/B
+    against the last committed ``BENCH_serve_*.json`` (tokens/s speedup vs
+    serial generate, absolute engine tokens/s, p99 TTFT as a
+    lower-is-better latency); the ``data`` suite replays the streaming
+    packed-vs-pad-to-max A/B against the last committed
+    ``BENCH_data_*.json`` (packed tokens/s speedup, padding waste
+    reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better
+    latency). A missing metric FAILS in every suite; ``all`` chains them
+    and fails on the worst."""
 
     def _opt(flag, default=None):
         if flag in argv:
@@ -1267,8 +1492,8 @@ def gate_main(argv: list) -> int:
 
     suite = _opt("--suite", "kernels")
     tolerance = float(_opt("--tolerance", _GATE_TOLERANCE))
-    if suite not in ("kernels", "elastic", "serve", "all"):
-        print(f"gate: unknown --suite {suite!r} (kernels|elastic|serve|all)", file=sys.stderr)
+    if suite not in ("kernels", "elastic", "serve", "data", "all"):
+        print(f"gate: unknown --suite {suite!r} (kernels|elastic|serve|data|all)", file=sys.stderr)
         return 2
 
     rcs = []
@@ -1305,6 +1530,20 @@ def gate_main(argv: list) -> int:
             current = bench_serve()
             if current is None:
                 print("gate: FAIL — serve bench child produced no results", file=sys.stderr)
+                return 2
+        rcs.append(run_gate(baseline, current, tolerance))
+    if suite in ("data", "all"):
+        baseline = _opt("--baseline") if suite == "data" else None
+        baseline = baseline or _latest_receipt("data")
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_data_*.json", file=sys.stderr)
+            return 2
+        current = _opt("--current") if suite == "data" else None
+        if current is None:
+            print("gate: running the data-plane A/B (data suite child)...", file=sys.stderr)
+            current = bench_data()
+            if current is None:
+                print("gate: FAIL — data bench child produced no results", file=sys.stderr)
                 return 2
         rcs.append(run_gate(baseline, current, tolerance))
     return max(rcs)
@@ -2310,6 +2549,8 @@ if __name__ == "__main__":
         elastic_child_main()
     elif "--serve-child" in sys.argv[1:]:
         serve_child_main()
+    elif "--data-child" in sys.argv[1:]:
+        data_child_main()
     elif "--probe-child" in sys.argv[1:]:
         probe_child_main()
     elif "--gate" in sys.argv[1:]:

@@ -90,7 +90,15 @@ def _run_telemetry_summary(run_dir: str) -> dict:
     if gp_path is not None:
         try:
             with open(gp_path, "r", encoding="utf-8") as f:
-                out["goodput"] = json.load(f)["totals"]
+                gp = json.load(f)
+            out["goodput"] = gp["totals"]
+            # the goodput advisor: same advice the end-of-run table printed,
+            # re-derived from the persisted per-epoch rows (advisory-only)
+            from .telemetry.goodput import advise_rows
+
+            advice = advise_rows(gp.get("epochs") or [])
+            if advice:
+                out["advice"] = advice
         except (OSError, ValueError, KeyError) as e:
             out["goodput_error"] = f"unreadable {gp_path}: {e}"
     else:
@@ -108,6 +116,30 @@ def _run_telemetry_summary(run_dir: str) -> dict:
     except FileNotFoundError as e:
         out["journal_error"] = str(e)
     return out
+
+
+def _native_info() -> dict:
+    """Build state of the C++ data-plane kernels (``libdmltpu.so``): a
+    missing build silently degrades ``pack_stream``/``interleave`` to the
+    interpreter-bound Python paths — correct, but the bandwidth win is
+    gone, so diag surfaces it instead of leaving it to a profiler."""
+    import os
+
+    from .native import interleave as _interleave
+    from .native import pack as _pack
+
+    so = os.path.join(os.path.dirname(os.path.abspath(_pack.__file__)), "libdmltpu.so")
+    info: dict = {
+        "pack": _pack.available(),
+        "interleave": _interleave.available(),
+        "lib": so if os.path.isfile(so) else None,
+    }
+    if not (info["pack"] and info["interleave"]):
+        info["hint"] = (
+            "native packer/interleaver not built — run `sh dmlcloud_tpu/native/build.sh` "
+            "(pack_stream/interleave fall back to the slower Python paths)"
+        )
+    return info
 
 
 def _diag_main(argv) -> int:
@@ -130,6 +162,7 @@ def _diag_main(argv) -> int:
     from .utils.logging import accelerator_info, general_diagnostics
 
     cache = cache_stats()
+    native = _native_info()
     telemetry = _run_telemetry_summary(args.run) if args.run else None
     if not args.json:
         print(f"dmlcloud_tpu {__version__}")
@@ -140,6 +173,13 @@ def _diag_main(argv) -> int:
             else "disabled (TrainingPipeline(compile_cache=True) or $DMLCLOUD_COMPILE_CACHE_DIR)"
         )
         print(f"* COMPILE CACHE:\n    - dir: {cache['dir']}\n    - state: {state}")
+        built = lambda b: "yes" if b else "NO"  # noqa: E731 - two-word formatter
+        print(
+            f"* NATIVE KERNELS:\n    - pack: {built(native['pack'])}\n"
+            f"    - interleave: {built(native['interleave'])}"
+        )
+        if native.get("hint"):
+            print(f"    - hint: {native['hint']}")
         if telemetry is not None:
             print(f"* TELEMETRY ({telemetry['run_dir']}):")
             gp = telemetry.get("goodput")
@@ -157,10 +197,13 @@ def _diag_main(argv) -> int:
                 print(f"    - journal: {j['spans']} spans across {j['ranks']} rank(s): {j['kinds']}")
             else:
                 print(f"    - journal: {telemetry.get('journal_error')}")
+            for line in telemetry.get("advice", []):
+                print(f"    - advice: {line}")
         return 0
 
     info = {"version": __version__, "python": sys.version.split()[0], "jax": jax.__version__}
     info["compile_cache"] = cache
+    info["native"] = native
     if telemetry is not None:
         info["telemetry"] = telemetry
     info.update(accelerator_info())  # {"error": ...} when backend init fails

@@ -2,6 +2,8 @@ from .datasets import (
     BatchDataset,
     DataPipeline,
     DownstreamDataset,
+    MixPipeline,
+    PackStats,
     PrefetchDataset,
     ShardedSequenceDataset,
     ShardedXrDataset,
@@ -18,6 +20,8 @@ __all__ = [
     "BatchDataset",
     "DataPipeline",
     "DownstreamDataset",
+    "MixPipeline",
+    "PackStats",
     "PrefetchDataset",
     "ShardedSequenceDataset",
     "ShardedXrDataset",

@@ -253,6 +253,61 @@ class DataPipeline(_DatasetBase):
             raise ValueError(f"seq_len must be >= 1, got {seq_len}")
         return self._chain(lambda it, _e: _pack_sequences_iter(it, seq_len, split_long))
 
+    def pack_stream(
+        self,
+        seq_len: int,
+        chunk_docs: int = 1024,
+        *,
+        split_long: bool = True,
+        stats: "PackStats | None" = None,
+    ) -> "DataPipeline":
+        """Streaming chunked packing: buffer up to ``chunk_docs`` documents,
+        flatten them to the two-numpy-buffer form, and hand the greedy fill
+        to the C++ packer (``native.pack.pack_flat``; the Python
+        ``pack_sequences`` loop when the library isn't built — bit-identical
+        either way), emitting ``{"tokens", "segment_ids"}`` rows that feed
+        the packed-attention path (``DecoderLM(segment_ids=...)`` +
+        ``lm_loss(..., segment_ids=...)``).
+
+        Unlike ``pack()`` (per-example Python loop) this is the production
+        input path for ragged corpora: memory stays O(``chunk_docs`` docs)
+        no matter how long the stream runs, and the packer works on flat
+        buffers instead of per-example Python objects. The cost of
+        chunking is a *boundary loss*: each chunk's final partially-filled
+        row is emitted padded instead of borrowing the next chunk's first
+        document, wasting at most ``seq_len - 1`` slots per chunk — a
+        fraction that shrinks as ``chunk_docs`` grows. The returned
+        pipeline's ``pack_stats`` (a :class:`PackStats`, live-updated
+        during iteration) accounts for it: total padding-waste fraction
+        and the chunk-boundary share, the numbers the ``BENCH_data_*``
+        receipts report (doc/data.md)."""
+        if seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        if chunk_docs < 1:
+            raise ValueError(f"chunk_docs must be >= 1, got {chunk_docs}")
+        st = stats if stats is not None else PackStats()
+
+        def wrap(it: Iterator, _e) -> Iterator:
+            return _pack_stream_iter(it, seq_len, chunk_docs, split_long, st)
+
+        out = self._chain(wrap)
+        out.pack_stats = st
+        return out
+
+    @classmethod
+    def mix(
+        cls,
+        sources: Sequence["DataPipeline"],
+        weights: Sequence[float] | None = None,
+        seed: int = 0,
+    ) -> "MixPipeline":
+        """Deterministic weighted sampling over child pipelines: element
+        ``t`` of the mixed stream comes from the source a counter-based
+        draw — a pure function of ``(seed, t)`` — selects by cumulative
+        weight. See :class:`MixPipeline` for the determinism and resume
+        contract (doc/data.md)."""
+        return MixPipeline(sources, weights=weights, seed=seed)
+
     def shuffle(self, buffer_size: int, seed: int = 0) -> "DataPipeline":
         """Streaming shuffle through a ``buffer_size`` reservoir (the
         tf.data idiom): each yield swaps a random buffer slot with the next
@@ -331,6 +386,312 @@ class DataPipeline(_DatasetBase):
             self._length_fn,
         )
 
+
+# ---------------------------------------------------------------------------
+# streaming chunked packing (the production ragged-corpus input path)
+# ---------------------------------------------------------------------------
+
+class PackStats:
+    """Live packing accounting of one ``pack_stream`` stage.
+
+    Updated as chunks are packed (cumulative across epochs unless
+    :meth:`reset` is called), readable at any point during iteration:
+
+    - ``docs`` / ``chunks`` / ``rows``: documents consumed, chunks packed,
+      fixed-shape rows emitted
+    - ``tokens_in``: real tokens entering the packer
+    - ``tokens_placed``: real tokens placed into rows (less than
+      ``tokens_in`` only when ``split_long=False`` truncates)
+    - ``slots``: ``rows * seq_len`` — every token slot emitted
+    - ``pad_slots``: slots holding padding (``segment_ids == 0``)
+    - ``boundary_pad_slots``: the subset of ``pad_slots`` in each chunk's
+      final row — the price of never packing across a chunk boundary
+    """
+
+    def __init__(self):
+        self.docs = 0
+        self.chunks = 0
+        self.rows = 0
+        self.tokens_in = 0
+        self.tokens_placed = 0
+        self.slots = 0
+        self.pad_slots = 0
+        self.boundary_pad_slots = 0
+
+    def reset(self) -> None:
+        self.__init__()
+
+    @property
+    def pad_fraction(self) -> float:
+        """Fraction of emitted slots that are padding (0.0 before any row)."""
+        return self.pad_slots / self.slots if self.slots else 0.0
+
+    @property
+    def boundary_fraction(self) -> float:
+        """Fraction of emitted slots wasted specifically on chunk-boundary
+        tail rows — the part a larger ``chunk_docs`` would reclaim."""
+        return self.boundary_pad_slots / self.slots if self.slots else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "docs": self.docs,
+            "chunks": self.chunks,
+            "rows": self.rows,
+            "tokens_in": self.tokens_in,
+            "tokens_placed": self.tokens_placed,
+            "slots": self.slots,
+            "pad_slots": self.pad_slots,
+            "boundary_pad_slots": self.boundary_pad_slots,
+            "pad_fraction": round(self.pad_fraction, 6),
+            "boundary_fraction": round(self.boundary_fraction, 6),
+        }
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"PackStats({self.as_dict()})"
+
+
+def _pack_stream_iter(docs: Iterator, seq_len: int, chunk_docs: int, split_long: bool, stats: PackStats) -> Iterator[dict]:
+    """Chunked packing core: per window of ``chunk_docs`` documents, one
+    flatten + one native ``pack_flat`` call (Python packer fallback —
+    bit-identical, asserted in tests), rows yielded one at a time so
+    downstream stages stream. Each chunk packs independently; the
+    resulting per-chunk rows are exactly ``pack_sequences(chunk)``."""
+    try:
+        from ..native import pack as _native_pack
+
+        native_ok = _native_pack.available()
+    except Exception:  # pragma: no cover - import guard
+        _native_pack, native_ok = None, False
+
+    def pack_chunk(buf: list) -> Iterator[dict]:
+        arrays = [np.asarray(d, np.int32).ravel() for d in buf]
+        stats.docs += len(arrays)
+        arrays = [a for a in arrays if a.size]  # the packer skips empty docs
+        if not arrays:
+            return
+        n_in = sum(int(a.size) for a in arrays)
+        stats.tokens_in += n_in
+        if native_ok:
+            lengths = np.fromiter((a.size for a in arrays), np.int64, count=len(arrays))
+            flat = np.concatenate(arrays)
+            tokens, segs = _native_pack.pack_flat(flat, lengths, seq_len, split_long=split_long)
+            rows = [{"tokens": tokens[i], "segment_ids": segs[i]} for i in range(len(tokens))]
+        else:
+            rows = list(_pack_sequences_iter(arrays, seq_len, split_long))
+        stats.chunks += 1
+        stats.rows += len(rows)
+        stats.slots += len(rows) * seq_len
+        pad = sum(int(np.count_nonzero(r["segment_ids"] == 0)) for r in rows)
+        stats.pad_slots += pad
+        stats.tokens_placed += len(rows) * seq_len - pad
+        if rows:
+            stats.boundary_pad_slots += int(np.count_nonzero(rows[-1]["segment_ids"] == 0))
+        yield from rows
+
+    buf: list = []
+    for doc in docs:
+        buf.append(doc)
+        if len(buf) == chunk_docs:
+            yield from pack_chunk(buf)
+            buf = []
+    if buf:
+        yield from pack_chunk(buf)
+
+
+# ---------------------------------------------------------------------------
+# deterministic weighted multi-source mixing
+# ---------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+
+
+def _mix_u64(seed: int, step: int) -> int:
+    """splitmix64-style counter hash: a uniform u64 that is a pure function
+    of ``(seed, step)`` — no RNG object, no hidden state, so the draw
+    sequence can be re-entered at any step (elastic resume) and is
+    identical on every rank and platform."""
+    x = (int(seed) * 0x9E3779B97F4A7C15 + (int(step) + 1) * 0xD1B54A32D192ED03) & _M64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _M64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _mix_choice(seed: int, step: int, weights: Sequence[float], alive: Sequence[bool]) -> int:
+    """Source index for draw ``step``: the u64 mapped onto the cumulative
+    weights of the still-alive sources (exhausted sources renormalize away
+    by carrying zero mass)."""
+    total = sum(w for w, a in zip(weights, alive) if a)
+    u = (_mix_u64(seed, step) / float(1 << 64)) * total
+    acc = 0.0
+    last = 0
+    for i, (w, a) in enumerate(zip(weights, alive)):
+        if not a:
+            continue
+        acc += w
+        last = i
+        if u < acc:
+            return i
+    return last  # float roundoff on the final boundary
+
+
+class MixPipeline(DataPipeline):
+    """Deterministic weighted mixing over child pipelines
+    (``DataPipeline.mix``).
+
+    The choice sequence is a pure function of ``(seed, draw index)``
+    (counter-based splitmix64 — no RNG object), so the mix is reproducible
+    run-to-run and resumable mid-stream: ``state_dict`` captures the draw
+    cursor plus every child's own PR-7 iterator state, and
+    ``load_state_dict`` fast-forwards the children and re-enters the draw
+    sequence at the exact next step — 0 replayed and 0 skipped samples,
+    including across a world-size change (all cursors are stored as
+    world-size-independent global offsets). A source that exhausts
+    renormalizes the remaining weights with a logged warning; the mix ends
+    when every source is exhausted."""
+
+    def __init__(
+        self,
+        sources: Sequence[DataPipeline],
+        weights: Sequence[float] | None = None,
+        seed: int = 0,
+    ):
+        sources = list(sources)
+        if not sources:
+            raise ValueError("mix needs at least one source")
+        if weights is None:
+            weights = [1.0] * len(sources)
+        weights = [float(w) for w in weights]
+        if len(weights) != len(sources):
+            raise ValueError(
+                f"mix got {len(sources)} source(s) but {len(weights)} weight(s)"
+            )
+        if any(not np.isfinite(w) or w <= 0 for w in weights):
+            raise ValueError(f"mix weights must be positive and finite, got {weights}")
+        self._sources = sources
+        self._weights = weights
+        self._seed = int(seed)
+        #: draws made by the CURRENT pass / carried in from a resume
+        self._draws = 0
+        self._draws_base = 0
+        #: elements the pass resumed past (load_state_dict arms it)
+        self._consumed_base = 0
+        self._exhausted = [False] * len(sources)
+        #: one-shot resume payload applied by the next __iter__
+        self._mix_resume: dict | None = None
+
+        def length() -> int:
+            return sum(len(s) for s in self._sources)
+
+        super().__init__(self._mix_iter, length)
+
+    # every shuffling stage of every child re-seeds together
+    def set_epoch(self, epoch: int) -> None:
+        super().set_epoch(epoch)
+        for s in self._sources:
+            if hasattr(s, "set_epoch"):
+                s.set_epoch(epoch)
+
+    def _mix_iter(self, epoch) -> Iterator:
+        # epoch folds into the seed (the shuffle() convention): each epoch
+        # draws a fresh deterministic choice sequence, and a mid-epoch
+        # resume re-derives the same one (state_dict carries the epoch)
+        seed = self._seed + (0 if epoch is None else int(epoch))
+        resume = self._mix_resume
+        self._mix_resume = None
+        if resume is None:
+            self._draws_base = 0
+            self._consumed_base = 0
+            alive = [True] * len(self._sources)
+        else:
+            self._draws_base = resume["draws"]
+            self._consumed_base = resume["consumed"]
+            alive = [not x for x in resume["exhausted"]]
+        self._draws = 0
+        self._exhausted = [not a for a in alive]
+        its = [iter(s) for s in self._sources]
+        while True:
+            live = [w for w, a in zip(self._weights, alive) if a]
+            if not live:
+                return
+            i = _mix_choice(seed, self._draws_base + self._draws, self._weights, alive)
+            self._draws += 1
+            try:
+                yield next(its[i])
+            except StopIteration:
+                alive[i] = False
+                self._exhausted[i] = True
+                if any(alive):
+                    import logging
+
+                    remaining = [w for w, a in zip(self._weights, alive) if a]
+                    logging.getLogger("dmlcloud_tpu").warning(
+                        "mix: source %d exhausted after %d draw(s); renormalizing "
+                        "over the %d remaining source(s) (weights %s)",
+                        i, self._draws_base + self._draws, len(remaining), remaining,
+                    )
+                continue
+
+    # -- resumable iteration state (doc/data.md, doc/elasticity.md) ---------
+    def state_dict(self) -> dict:
+        """The mix cursor — global element offset AND global draw count
+        (draws outnumber yields when a draw hit an exhausted source) — plus
+        every child's own iterator state. All counters are global
+        (``local x world_size``), so a resume on a different world size
+        re-derives its per-rank position exactly like the base class."""
+        ws = runtime.world_size()
+        return {
+            "v": 1,
+            "kind": "mix",
+            "epoch": self.epoch,
+            "global_offset": (self._consumed_base + self._consumed) * ws,
+            "global_draws": (self._draws_base + self._draws) * ws,
+            "world_size": ws,
+            "exhausted": list(self._exhausted),
+            "children": [s.state_dict() for s in self._sources],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a mix ``state_dict``: children fast-forward through their
+        OWN ``load_state_dict`` (no replay through the mix), and the next
+        pass re-enters the draw sequence at the saved step. A plain
+        (non-mix) v1 state degrades to the base class's replay skip — the
+        draws are pure in ``(seed, step)``, so replay reproduces the exact
+        same choices."""
+        if not (isinstance(state, dict) and state.get("kind") == "mix"):
+            super().load_state_dict(state)
+            return
+        if state.get("v") != 1:
+            raise ValueError(f"unrecognised MixPipeline state: {state!r}")
+        children = state.get("children") or []
+        if len(children) != len(self._sources):
+            raise ValueError(
+                f"mix state carries {len(children)} child state(s) for "
+                f"{len(self._sources)} source(s)"
+            )
+        for s, cs in zip(self._sources, children):
+            s.load_state_dict(cs)
+        if state.get("epoch") is not None:
+            self.set_epoch(int(state["epoch"]))
+        ws = runtime.world_size()
+        consumed, rem_c = divmod(int(state["global_offset"]), ws)
+        draws, rem_d = divmod(int(state["global_draws"]), ws)
+        if rem_c or rem_d:
+            import logging
+
+            logging.getLogger("dmlcloud_tpu").warning(
+                "mix resume: global cursor (%d elements, %d draws) is not divisible "
+                "by the new world size %d; rounding down",
+                state["global_offset"], state["global_draws"], ws,
+            )
+        self._pending_skip = 0  # children fast-forward themselves
+        self._mix_resume = {
+            "consumed": consumed,
+            "draws": draws,
+            "exhausted": [bool(x) for x in state.get("exhausted", [])]
+            or [False] * len(self._sources),
+        }
 
 def _iter_chunks(
     ds, dim, chunk_size, chunk_overlap, even_shards, equal_chunks, shuffle, seed, rank, world_size, load, load_kwargs

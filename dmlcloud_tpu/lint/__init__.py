@@ -60,6 +60,7 @@ from .engine import (  # noqa: F401
 from . import rules  # noqa: F401  — importing registers the rules
 from . import rules_sharding  # noqa: F401  — DML2xx sharding/collective family
 from . import rules_perf  # noqa: F401  — DML205/206 donation & remat contracts
+from . import rules_data  # noqa: F401  — DML209 packed segment_ids contract
 from . import rules_concurrency  # noqa: F401  — DML3xx concurrency family
 from .sanitize import SANITIZE_MODES, Sanitizer, SanitizerError  # noqa: F401
 from .traceguard import RetraceError, TraceGuard  # noqa: F401

@@ -726,7 +726,7 @@ def _lint_file_task(args: tuple) -> list[Finding]:
     Re-imports register the rules in the child; the project context arrives
     as a plain axes set."""
     path, select, ignore, axes = args
-    from . import rules, rules_concurrency, rules_perf, rules_sharding  # noqa: F401 — register rules
+    from . import rules, rules_concurrency, rules_data, rules_perf, rules_sharding  # noqa: F401 — register rules
 
     project = dataflow.ProjectContext(declared_axes=set(axes))
     return lint_file(path, select=select, ignore=ignore, project=project)

@@ -763,6 +763,10 @@ class TrainingPipeline:
             return
         if ledger.rows:
             self.logger.info("\n%s", ledger.format_table())
+            # advisory-only knob suggestions (goodput advisor): printed,
+            # never auto-applied — the same lines `diag --run` derives
+            for line in ledger.advise():
+                self.logger.warning("goodput advisor: %s", line)
         import json
         import os
 

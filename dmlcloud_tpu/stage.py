@@ -357,6 +357,12 @@ class TrainValStage(Stage):
         #: for MFU when step_flops() is not declared
         self._gp_data_wait_ns = 0
         self._cost_flops: float | None = None
+        #: padding accounting over this epoch's HOST batches (telemetry
+        #: only): slots whose ``segment_ids`` mark padding vs all token
+        #: slots — ``misc/pad_fraction``, the signal the goodput advisor
+        #: and the data-plane receipts read (doc/data.md)
+        self._gp_pad_slots = 0
+        self._gp_token_slots = 0
 
     # -- overridables (parity: reference stage.py:228-257) ------------------
     def train_dataset(self):
@@ -946,6 +952,8 @@ class TrainValStage(Stage):
     def _pre_epoch(self):
         self._stall.reset()  # misc/host_stall_ms is a per-epoch total
         self._gp_data_wait_ns = 0
+        self._gp_pad_slots = 0
+        self._gp_token_slots = 0
         super()._pre_epoch()
 
     @property
@@ -979,6 +987,13 @@ class TrainValStage(Stage):
                 reduction=Reduction.MEAN,
                 prefixed=False,
             )
+            if self._gp_token_slots:
+                self.track_reduce(
+                    "misc/pad_fraction",
+                    round(self._gp_pad_slots / self._gp_token_slots, 6),
+                    reduction=Reduction.MEAN,
+                    prefixed=False,
+                )
         if self._train_compiled is not None:
             # signatures that showed up this epoch WITHOUT a precompiled
             # executable — each one was a mid-run XLA compile (0 is the goal;
@@ -1367,6 +1382,8 @@ class TrainValStage(Stage):
         thread (data/device.py) — or per-step synchronous puts when disabled.
         With ``buckets()`` armed, batches are bucket-padded (+ mask) on host
         BEFORE the transfer, so the device only ever sees bucket shapes."""
+        if self._telemetry_armed:
+            ds = self._count_padding(ds)
         if self._buckets_resolved:
             from .compile.buckets import bucket_iterator
 
@@ -1379,6 +1396,22 @@ class TrainValStage(Stage):
                 ds, self.mesh, prefetch=prefetch, host_prefetch=int(self.host_prefetch())
             )
         return (self._put(batch) for batch in ds)
+
+    def _count_padding(self, ds):
+        """Account padding in HOST batches that carry ``segment_ids`` (the
+        packed/pad-masked input contract, doc/data.md): slots with id 0 are
+        padding — FLOPs the step burns without learning. Feeds
+        ``misc/pad_fraction`` and the goodput advisor's "enable
+        pack_stream" suggestion. Telemetry-armed runs only (one numpy
+        compare per batch, before any device transfer); non-numpy leaves
+        (already-on-device batches) are left untouched — no implicit D2H."""
+        for batch in ds:
+            if isinstance(batch, dict):
+                seg = batch.get("segment_ids")
+                if isinstance(seg, np.ndarray) and seg.size:
+                    self._gp_pad_slots += int(np.count_nonzero(seg == 0))
+                    self._gp_token_slots += int(seg.size)
+            yield batch
 
     def _timed_feed(self, ds):
         """``_feed`` with each ``next()`` timed as the goodput ledger's

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["GoodputLedger", "ledger_from_tracker", "flops_from_compiled"]
+__all__ = ["GoodputLedger", "ledger_from_tracker", "flops_from_compiled", "advise_rows"]
 
 #: tracker metric -> ledger column (values in ms except goodput/mfu)
 _EPOCH_METRICS = {
@@ -40,7 +40,14 @@ _EPOCH_METRICS = {
     "misc/host_stall_ms": "stall_total_s",
     "misc/goodput": "goodput",
     "misc/mfu": "mfu",
+    "misc/pad_fraction": "pad_fraction",
 }
+
+#: data_wait share of an epoch above which the advisor speaks up
+_ADVISE_DATA_WAIT_FRAC = 0.3
+
+#: pad share of the token slots above which packing is worth suggesting
+_ADVISE_PAD_FRAC = 0.1
 
 
 def _get(tracker, name: str, epoch_idx: int) -> float | None:
@@ -83,6 +90,10 @@ class GoodputLedger:
     def to_dict(self) -> dict:
         return {"v": 1, "epochs": self.rows, "totals": self.totals()}
 
+    def advise(self) -> list[str]:
+        """Advisory knob suggestions from this ledger (see ``advise_rows``)."""
+        return advise_rows(self.rows)
+
     # -- rendering -----------------------------------------------------------
     def format_table(self) -> str:
         """The root-only end-of-run table."""
@@ -119,6 +130,52 @@ class GoodputLedger:
         return "\n".join(lines)
 
 
+def advise_rows(rows: list[dict]) -> list[str]:
+    """Advisory-only tuning suggestions from ledger epoch rows (the
+    ROADMAP-3 goodput-advisor slice): when ``data_wait_s`` exceeds 30%
+    of an epoch's wall time, the input pipeline — not the device —
+    is the bottleneck, and the fix is a concrete knob:
+
+    - raise ``prefetch(n)`` / ``prefetch_depth()`` or enable
+      ``host_prefetch()`` so host batch prep overlaps the step, and
+    - when the batches carry a pad mask (``misc/pad_fraction`` tracked,
+      i.e. ``segment_ids`` mark wasted slots), enable
+      ``DataPipeline.pack_stream`` — every padded slot is a token of
+      data-pipeline AND device time spent on nothing.
+
+    Nothing is auto-mutated: the list is printed by the end-of-run table
+    and by ``diag --run`` for a human to act on. Shared by both so the
+    advice cannot diverge (doc/observability.md, doc/data.md)."""
+    starved = [
+        r["epoch"]
+        for r in rows
+        if r.get("epoch_s") and (r.get("data_wait_s") or 0.0) > _ADVISE_DATA_WAIT_FRAC * r["epoch_s"]
+    ]
+    if not starved:
+        return []
+    worst = max(
+        ((r.get("data_wait_s") or 0.0) / r["epoch_s"] for r in rows if r.get("epoch_s")),
+        default=0.0,
+    )
+    epochs = ", ".join(str(e) for e in starved[:8]) + ("…" if len(starved) > 8 else "")
+    advice = [
+        f"data_wait exceeded {_ADVISE_DATA_WAIT_FRAC:.0%} of epoch time in "
+        f"epoch(s) {epochs} (worst {worst:.0%}): the input pipeline is "
+        "starving the device — raise the pipeline's prefetch(n) / the stage's "
+        "prefetch_depth(), or enable host_prefetch() to move batch prep off "
+        "the training thread (doc/performance.md §3)"
+    ]
+    pads = [r["pad_fraction"] for r in rows if r.get("pad_fraction") is not None]
+    if pads and max(pads) > _ADVISE_PAD_FRAC:
+        advice.append(
+            f"batches carry a pad mask and {max(pads):.0%} of token slots are "
+            "padding: enable DataPipeline.pack_stream(seq_len) to pack "
+            "documents into full rows — the data pipeline moves (and the "
+            "device computes) only real tokens (doc/data.md)"
+        )
+    return advice
+
+
 def ledger_from_tracker(tracker) -> GoodputLedger:
     """Build the ledger from the (already cross-host-reduced) tracker
     histories. Epochs that never tracked the telemetry metrics (telemetry
@@ -140,6 +197,7 @@ def ledger_from_tracker(tracker) -> GoodputLedger:
             "ckpt_s": round(ckpt_ms / 1e3, 6) if ckpt_ms is not None else None,
             "goodput": _get(tracker, "misc/goodput", i),
             "mfu": _get(tracker, "misc/mfu", i),
+            "pad_fraction": _get(tracker, "misc/pad_fraction", i),
         }
         # host_stall bucket excludes the checkpoint share (disjoint buckets)
         if stall_ms is not None:

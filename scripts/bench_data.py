@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Data-plane A/B receipt: the streaming packed input path
+(``DataPipeline.mix -> pack_stream -> batch``) vs pad-to-max on the pinned
+ragged corpus (doc/data.md):
+
+- real (non-padding) tokens/s through the SAME TrainValStage train step
+  for both arms — the pad arm burns ~3/4 of every batch on padding, the
+  packed arm reclaims it
+- padding-waste fraction before vs after, with the chunk-boundary share
+  reported separately (the part a larger ``chunk_docs`` would reclaim)
+- data_wait_s from the telemetry ledger and 0 mid-run recompiles (packed
+  rows are fixed-shape by construction; AOT-precompiled signature)
+
+Thin CLI over ``bench.bench_data`` (which runs ``bench.py --data-child``
+CPU-pinned) so the committed receipt and an interactive investigation run
+the exact same workload. The receipt's flat ``gate`` section is what
+``bench.py --gate --suite data`` / scripts/perf_gate.sh compares.
+
+    JAX_PLATFORMS=cpu python scripts/bench_data.py --out BENCH_data_pr09.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    args = parser.parse_args()
+
+    from bench import bench_data
+
+    results = bench_data()
+    if results is None:
+        print("data bench failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

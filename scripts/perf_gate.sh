@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# CI perf gate, three suites (doc/performance.md §"Kernel receipts",
-# doc/elasticity.md, doc/serving.md):
+# CI perf gate, four suites (doc/performance.md §"Kernel receipts",
+# doc/elasticity.md, doc/serving.md, doc/data.md):
 #
 #   kernels  current kernel ratios (flash fwd / fwd+bwd vs unfused,
 #            speculative speedup + accept rate, int8 decode) and goodput
@@ -12,6 +12,10 @@
 #   serve    the continuous-batching serving A/B (Poisson trace, engine vs
 #            serial generate) vs the last committed BENCH_serve_*.json —
 #            tokens/s speedup, engine tokens/s, p99 TTFT (lower-is-better)
+#   data     the streaming packed data plane A/B (mix -> pack_stream vs
+#            pad-to-max on the pinned ragged corpus) vs the last committed
+#            BENCH_data_*.json — packed tokens/s speedup, padding waste
+#            reclaimed, 0 mid-run recompiles, data_wait_s (lower-is-better)
 #
 # Runs after the lint gate in the CI flow:
 #

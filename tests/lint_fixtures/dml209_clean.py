@@ -1,0 +1,75 @@
+"""DML209 clean fixture: packed pipelines with segment_ids plumbed through
+both the model call and the loss — plus the shapes that must NOT trigger
+(unpacked modules, unrelated ``.pack`` receivers, opaque receivers,
+explicit ``segment_ids=None`` plumbing).
+
+Static lint corpus — never imported or executed.
+"""
+
+import struct
+
+import numpy as np
+
+from dmlcloud_tpu.data import DataPipeline
+from dmlcloud_tpu.models.transformer import chunked_lm_loss, lm_loss
+
+
+class PackedStage:
+    def pre_stage(self):
+        docs = [np.arange(n) for n in (3, 5, 7)]
+        ds = DataPipeline.from_source(docs).pack_stream(128, chunk_docs=64)
+        self.pipeline.register_dataset("train", ds.batch(8))
+
+    def step(self, state, batch):
+        # both consumers honor the packed contract: clean
+        logits = state.apply_fn(
+            {"params": state.params}, batch["tokens"], segment_ids=batch["segment_ids"]
+        )
+        return lm_loss(logits, batch["tokens"], segment_ids=batch["segment_ids"])
+
+
+def packed_positional_segs(model, params, batch, docs):
+    # lm_loss's third positional IS segment_ids — clean
+    p = DataPipeline.from_source(docs).pack(64)
+    logits = model.apply({"params": params}, batch["tokens"], segment_ids=batch["segment_ids"])
+    return lm_loss(logits, batch["tokens"], batch["segment_ids"]), p
+
+
+def packed_chunked(state, batch, pipeline_rows):
+    ds = DataPipeline.from_source(pipeline_rows).pack_stream(256)
+    hidden = state.apply_fn(
+        {"params": state.params}, batch["tokens"], segment_ids=batch["segment_ids"],
+        return_hidden=True,
+    )
+    kernel = state.params["lm_head"]["kernel"]
+    return chunked_lm_loss(
+        hidden, kernel, batch["tokens"], segment_ids=batch["segment_ids"]
+    ), ds
+
+
+def explicit_none_is_plumbed(state, batch, docs):
+    # segment_ids=None is a runtime decision (--pack flag off); the
+    # PLUMBING exists, which is all the rule can check statically
+    ds = DataPipeline.from_source(docs).pack_stream(128)
+    logits = state.apply_fn({"params": state.params}, batch["tokens"], segment_ids=None)
+    return lm_loss(logits, batch["tokens"], segment_ids=None), ds
+
+
+def unpacked_module_is_silent(state, batch):
+    # no packing anywhere in this scope: full-length rows need no segs
+    logits = state.apply_fn({"params": state.params}, batch["tokens"])
+    return lm_loss(logits, batch["tokens"])
+
+
+def unrelated_pack_receiver(state, batch, values):
+    # struct.pack is not a DataPipeline: must not mark the scope packed
+    blob = struct.pack("<I", len(values))
+    logits = state.apply_fn({"params": state.params}, batch["tokens"])
+    return lm_loss(logits, batch["tokens"]), blob
+
+
+def opaque_receiver_stays_silent(state, batch, pipeline):
+    # the receiver is an opaque argument — unresolvable, so never a guess
+    packed = pipeline.pack(512)
+    logits = state.apply_fn({"params": state.params}, batch["tokens"])
+    return lm_loss(logits, batch["tokens"]), packed

@@ -291,3 +291,101 @@ def test_committed_elastic_receipt_satisfies_the_gate():
     assert receipt["save_on_preempt_latency_s"] > 0
     assert receipt["time_to_resume_s"] > 0
     assert receipt["requeue_verdict"]["requeue"] is True
+
+
+# -------------------------------------------------------------- data suite
+
+DATA_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "padding_waste_reclaimed": 0.5,
+    "gate": {
+        "data_packed_speedup_vs_pad": 2.5,
+        "data_packed_tokens_per_sec": 7000.0,
+        "data_padding_waste_reclaimed": 0.5,
+        "data_zero_recompiles": 1.0,
+        "data_wait_s": 0.04,
+    },
+}
+
+
+def test_data_gate_passes_against_itself(tmp_path):
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=dict(DATA_RECEIPT)) == 0
+
+
+def test_data_gate_fails_against_doctored_regression(tmp_path, capsys):
+    """A packed stream that stopped beating pad-to-max (the speedup
+    collapses toward 1x) FAILS the gate."""
+    doctored = json.loads(json.dumps(DATA_RECEIPT))
+    doctored["gate"]["data_packed_speedup_vs_pad"] = 1.05
+    doctored["gate"]["data_packed_tokens_per_sec"] = 2900.0
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    cur = _write(tmp_path, "doctored.json", doctored)
+    assert run_gate(base, current=cur) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "data_packed_speedup_vs_pad" in out
+
+
+def test_data_mid_run_recompile_fails(tmp_path, capsys):
+    """A packed pipeline that started emitting ragged shapes (mid-run XLA
+    compiles) reports data_zero_recompiles 0.0 — a 100% drop, always FAIL."""
+    doctored = json.loads(json.dumps(DATA_RECEIPT))
+    doctored["gate"]["data_zero_recompiles"] = 0.0
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "data_zero_recompiles" in capsys.readouterr().out
+
+
+def test_data_wait_is_lower_is_better(tmp_path, capsys):
+    """data_wait_s is a latency: growth past the wide latency tolerance
+    (the packer falling back to a pathological path) fails; shrinking
+    always passes."""
+    slow = json.loads(json.dumps(DATA_RECEIPT))
+    slow["gate"]["data_wait_s"] = 0.04 * 2.5  # > 2x baseline
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "data_wait_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(DATA_RECEIPT))
+    fast["gate"]["data_wait_s"] = 0.005
+    assert run_gate(base, current=fast) == 0
+
+
+def test_data_missing_metric_fails(tmp_path, capsys):
+    """PR-6 semantics: a data metric that silently vanishes is a FAIL."""
+    current = {"gate": {"data_packed_speedup_vs_pad": 2.5}}
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_data_suite_with_explicit_files(tmp_path):
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    cur = _write(tmp_path, "cur.json", DATA_RECEIPT)
+    assert gate_main(["--gate", "--suite", "data", "--baseline", base, "--current", cur]) == 0
+
+
+def test_committed_data_receipt_satisfies_the_gate():
+    """The committed PR 9 receipt must pass its own gate, beat pad-to-max
+    by the acceptance floor (1.3x real tokens/s), report the padding waste
+    reclaimed, certify 0 mid-run recompiles, and be honest about where it
+    ran."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_data_pr09.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    assert receipt["gate"]["data_packed_speedup_vs_pad"] >= 1.3
+    assert receipt["gate"]["data_padding_waste_reclaimed"] > 0.3
+    assert receipt["gate"]["data_zero_recompiles"] == 1.0
+    assert receipt["value_source"] == "cpu_smoke"
+    assert receipt["pad_to_max"]["recompiles"] == 0
+    assert receipt["packed_stream"]["recompiles"] == 0
+    # both arms trained the same corpus: real token counts agree to within
+    # the dropped-remainder batches
+    pad_tok = receipt["pad_to_max"]["real_tokens_per_epoch"]
+    packed_tok = receipt["packed_stream"]["real_tokens_per_epoch"]
+    assert abs(pad_tok - packed_tok) / pad_tok < 0.1
+    # the boundary loss is reported and small relative to total padding
+    pack = receipt["packed_stream"]["pack"]
+    assert 0.0 <= pack["boundary_fraction"] <= pack["pad_fraction"]

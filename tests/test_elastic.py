@@ -555,3 +555,105 @@ def test_nan_failure_writes_no_requeue_verdict(tmp_path, single_runtime):
     verdict = read_requeue_verdict(pipe.checkpoint_dir.path)
     assert verdict["requeue"] is False and verdict["kind"] == "exception"
     pipe.checkpoint_dir.close()
+
+
+# ---------------------------------------------------------------------------
+# MixPipeline: the elastic contract (world-size scaling + the drill)
+# ---------------------------------------------------------------------------
+
+class TestMixElasticContract:
+    def _mk(self):
+        return DataPipeline.mix(
+            [
+                DataPipeline.from_source(list(range(100, 130))),
+                DataPipeline.from_source(list(range(200, 220))),
+            ],
+            weights=[3, 1],
+            seed=5,
+        )
+
+    def test_world_size_change_scales_mix_cursor(self, single_runtime, monkeypatch):
+        """Save under world size 4, resume under 2: the element offset, the
+        draw counter, and every CHILD cursor are stored globally and
+        re-derived per-rank — the lock that makes a reshard resume land on
+        the exact next sample instead of replaying or skipping."""
+        m = self._mk()
+        it = iter(m)
+        consumed = [next(it) for _ in range(3)]
+        from_a = sum(1 for x in consumed if x < 200)
+        monkeypatch.setattr(runtime, "world_size", lambda: 4)
+        state = m.state_dict()
+        assert state["kind"] == "mix" and state["world_size"] == 4
+        assert state["global_offset"] == 12 and state["global_draws"] == 12
+        assert state["children"][0]["global_offset"] == from_a * 4
+        assert state["children"][1]["global_offset"] == (3 - from_a) * 4
+
+        monkeypatch.setattr(runtime, "world_size", lambda: 2)
+        fresh = self._mk()
+        fresh.load_state_dict(state)
+        # per-rank cursors under the NEW world size: global / 2
+        assert fresh._mix_resume == {
+            "consumed": 6,
+            "draws": 6,
+            "exhausted": [False, False],
+        }
+        assert fresh._sources[0]._pending_skip == from_a * 2
+        assert fresh._sources[1]._pending_skip == (3 - from_a) * 2
+        # no replay through the mix itself: children fast-forward themselves
+        assert fresh._pending_skip == 0
+        # and after one element the resumed cursor continues globally
+        next(iter(fresh))
+        assert fresh.state_dict()["global_offset"] == 12 + 2
+
+    def test_indivisible_mix_cursor_warns_and_rounds_down(self, single_runtime, monkeypatch, caplog):
+        m = self._mk()
+        it = iter(m)
+        for _ in range(3):
+            next(it)
+        monkeypatch.setattr(runtime, "world_size", lambda: 4)
+        state = m.state_dict()  # 12 global
+        monkeypatch.setattr(runtime, "world_size", lambda: 5)
+        fresh = self._mk()
+        with caplog.at_level("WARNING", logger="dmlcloud_tpu"):
+            fresh.load_state_dict(state)
+        assert fresh._mix_resume["consumed"] == 2  # 12 // 5
+        assert any("not divisible" in r.message for r in caplog.records)
+
+    def test_drill_with_mix_datapipeline(self, tmp_path, single_runtime):
+        """The preemption drill fed by a weighted mix: SIGTERM mid-epoch,
+        drain at the save boundary, resume on a smaller mesh — the step-save
+        sidecar carries the MIX state (kind 'mix', child cursors included)
+        and the resumed trajectory matches the uninterrupted control with 0
+        replayed or skipped samples."""
+        batches = _drill_batches()
+
+        def make_ds(kill_after=None):
+            first = _SigtermAfter(batches[:5], kill_after)
+            return DataPipeline.mix(
+                [DataPipeline.from_source(first), DataPipeline.from_source(batches[5:])],
+                weights=[2, 1],
+                seed=3,
+            )
+
+        _, control = _drill_run(tmp_path / "control", make_ds(), 2)
+        want = np.asarray(control.state.params["w"])
+        assert int(control.state.step) == 2 * N_BATCHES
+
+        pipe1, stage1 = _drill_run(tmp_path / "run", make_ds(kill_after=3), 4, preemptible=True)
+        assert stage1._mid_epoch_exit
+        drained = int(stage1.state.step)
+        assert 0 < drained < N_BATCHES and drained % SAVE_EVERY == 0
+        meta = json.loads(
+            (pipe1.checkpoint_dir.path / "meta" / "stage.steps" / f"{drained}.json").read_text()
+        )
+        assert meta["data"]["kind"] == "mix"
+        assert meta["data"]["global_offset"] == drained
+        assert len(meta["data"]["children"]) == 2
+
+        pipe2, stage2 = _drill_run(pipe1.checkpoint_dir.path, make_ds(), 2)
+        # exact resumption: 2 epochs x 10 mixed batches, not one step more
+        # or less — a replayed or skipped sample cannot produce step == 20
+        assert int(stage2.state.step) == 2 * N_BATCHES
+        np.testing.assert_allclose(
+            np.asarray(stage2.state.params["w"]), want, rtol=1e-5, atol=1e-6
+        )

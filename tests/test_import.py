@@ -36,3 +36,37 @@ def test_cli_diagnostics_text(capsys):
     assert main([]) == 0
     out = capsys.readouterr().out
     assert "* ACCELERATORS:" in out and "* VERSIONS:" in out
+
+
+def test_cli_diagnostics_native_block(capsys):
+    """diag surfaces the native data-plane kernels' build state: a missing
+    libdmltpu.so silently degrades pack_stream/interleave to the Python
+    paths, so the JSON carries pack/interleave booleans and — when not
+    built — a build hint."""
+    import json
+
+    from dmlcloud_tpu.__main__ import main
+
+    assert main(["--json"]) == 0
+    info = json.loads(capsys.readouterr().out.strip())
+    native = info["native"]
+    assert set(native) >= {"pack", "interleave", "lib"}
+    assert isinstance(native["pack"], bool) and isinstance(native["interleave"], bool)
+    if not (native["pack"] and native["interleave"]):
+        assert "build.sh" in native["hint"]
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "* NATIVE KERNELS:" in out
+
+
+def test_cli_diagnostics_native_block_reports_missing(capsys, monkeypatch):
+    from dmlcloud_tpu import __main__ as cli
+    from dmlcloud_tpu.native import interleave as il
+    from dmlcloud_tpu.native import pack as pk
+
+    monkeypatch.setattr(pk, "available", lambda: False)
+    monkeypatch.setattr(il, "available", lambda: False)
+    info = cli._native_info()
+    assert info["pack"] is False and info["interleave"] is False
+    assert "build.sh" in info["hint"]

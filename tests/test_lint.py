@@ -44,6 +44,7 @@ BAD_EXPECT = {
     "DML206": 3,
     "DML207": 3,
     "DML208": 4,
+    "DML209": 5,
     "DML301": 2,
     "DML302": 2,
 }

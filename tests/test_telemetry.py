@@ -531,3 +531,86 @@ class TestWatchdog:
         assert "uncaught exception" in dump["reason"]
         assert "boom mid-run" in dump["reason"]
         assert not pipeline.telemetry_armed  # teardown still disarmed cleanly
+
+
+# ---------------------------------------------------------------------------
+# goodput advisor (ROADMAP-3 slice): doctored ledgers -> concrete knobs
+# ---------------------------------------------------------------------------
+
+
+class TestGoodputAdvisor:
+    def _row(self, epoch, epoch_s, data_wait_s, pad_fraction=None):
+        return {
+            "epoch": epoch,
+            "epoch_s": epoch_s,
+            "data_wait_s": data_wait_s,
+            "ckpt_s": 0.0,
+            "stall_s": 0.1,
+            "productive_s": max(epoch_s - data_wait_s - 0.1, 0.0),
+            "goodput": None,
+            "mfu": None,
+            "pad_fraction": pad_fraction,
+        }
+
+    def test_quiet_below_the_threshold(self):
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        assert advise_rows([self._row(1, 10.0, 1.0), self._row(2, 10.0, 2.9)]) == []
+        assert advise_rows([]) == []
+
+    def test_data_wait_dominance_suggests_prefetch(self):
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        advice = advise_rows([self._row(1, 10.0, 0.5), self._row(2, 10.0, 4.2)])
+        assert len(advice) == 1
+        assert "prefetch" in advice[0] and "host_prefetch" in advice[0]
+        assert "epoch(s) 2" in advice[0]
+
+    def test_pad_mask_adds_the_pack_stream_suggestion(self):
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        advice = advise_rows([self._row(1, 10.0, 4.0, pad_fraction=0.72)])
+        assert len(advice) == 2
+        assert "pack_stream" in advice[1] and "72%" in advice[1]
+        # a mask with little padding does not trigger the packing advice
+        advice = advise_rows([self._row(1, 10.0, 4.0, pad_fraction=0.05)])
+        assert len(advice) == 1
+
+    def test_ledger_advise_delegates(self):
+        from dmlcloud_tpu.telemetry.goodput import GoodputLedger, advise_rows
+
+        rows = [self._row(1, 10.0, 5.0, pad_fraction=0.5)]
+        assert GoodputLedger(rows).advise() == advise_rows(rows)
+
+    def test_diag_run_reports_advice_from_doctored_ledger(self, tmp_path, capsys):
+        """diag --run derives the SAME advice from the persisted
+        goodput.json rows — no live tracker needed."""
+        tele = tmp_path / "telemetry"
+        tele.mkdir()
+        doctored = {
+            "v": 1,
+            "epochs": [self._row(1, 10.0, 6.0, pad_fraction=0.7)],
+            "totals": {"epochs": 1, "wall_s": 10.0, "compile_s": 0.0, "data_wait_s": 6.0,
+                       "ckpt_s": 0.0, "host_stall_s": 0.1, "productive_s": 3.9,
+                       "goodput_frac": 0.39, "mfu": None},
+        }
+        (tele / "goodput.json").write_text(json.dumps(doctored))
+        rc = cli_main(["diag", "--json", "--run", str(tmp_path)])
+        info = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        advice = info["telemetry"]["advice"]
+        assert len(advice) == 2
+        assert "prefetch" in advice[0] and "pack_stream" in advice[1]
+
+        cli_main(["diag", "--run", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "advice:" in out and "pack_stream" in out
+
+    def test_healthy_run_gets_no_advice(self, tele_run, capsys):
+        """The real telemetry e2e run (tiny batches, no starvation) stays
+        quiet — the advisor only speaks on evidence."""
+        from dmlcloud_tpu.telemetry.goodput import ledger_from_tracker
+
+        ledger = ledger_from_tracker(tele_run.tracker)
+        for line in ledger.advise():
+            assert "data_wait" in line  # if it ever fires here, it is honest
