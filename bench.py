@@ -1055,11 +1055,175 @@ def _serve_serial_arm(model, params, trace):
     }, outs
 
 
+#: the CPU-smoke SPECULATIVE serving A/B config — pinned so receipts stay
+#: comparable. Same Poisson arrival law as _SERVE_CFG, but prompts come
+#: from a learnable Markov chain and the target/draft pair is TRAINED on
+#: it first (kernel_spec_ab's recipe): speculation's win IS the accept
+#: rate, so an untrained pair would measure nothing. The ~60x-smaller
+#: draft makes a proposal pass nearly free next to a verify. max_slots=2,
+#: k=3 keeps the smoke's verify pass (slots x (k+1) positions) inside the
+#: CPU's weight-bandwidth-bound regime — the regime TPU decode lives in
+#: at much larger batches; at 8 slots the CPU smoke turns compute-bound
+#: and measures the wrong machine (sweep in PR 10's notes: 1.73x at 2
+#: slots vs 1.09x at 8).
+_SERVE_SPEC_CFG = dict(
+    vocab=256, max_seq_len=192, k=3,
+    target=dict(layers=5, heads=8, kv=4, head_dim=48, hidden=384, mlp=1024),
+    draft=dict(layers=1, heads=2, kv=1, head_dim=32, hidden=96, mlp=256),
+    train_steps=120, train_b=8, train_s=48, train_lr=2e-3,
+    n_requests=24, prompt_lens=(16, 32, 48), new_tokens=(24, 32, 48),
+    mean_interarrival_s=0.02, seed=0,
+    block_size=16, num_blocks=64, max_slots=2, prefill_chunk=32,
+)
+
+
+def _spec_serve_models():
+    """The trained target/draft pair of the speculative serving A/B: both
+    models fit the same pinned Markov corpus (fp32 — greedy token-identity
+    is exact), so the draft genuinely agrees with the target and the
+    receipt's accept rate is a property of speculation, not luck."""
+    from dmlcloud_tpu.data import markov_tokens
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
+
+    c = _SERVE_SPEC_CFG
+
+    def build(kind):
+        mc = c[kind]
+        cfg = TransformerConfig(
+            vocab_size=c["vocab"], num_layers=mc["layers"], num_heads=mc["heads"],
+            num_kv_heads=mc["kv"], head_dim=mc["head_dim"], hidden_dim=mc["hidden"],
+            mlp_dim=mc["mlp"], max_seq_len=c["max_seq_len"], dtype=jnp.float32,
+        )
+        return DecoderLM(cfg)
+
+    target, draft = build("target"), build("draft")
+    n_batches = 8
+    corpus = markov_tokens(c["vocab"], c["train_b"] * n_batches, c["train_s"])
+    batches = [
+        jnp.asarray(corpus[i * c["train_b"]:(i + 1) * c["train_b"]], jnp.int32)
+        for i in range(n_batches)
+    ]
+
+    def train(model, seed):
+        params = model.init(jax.random.PRNGKey(seed), batches[0][:1, :8])["params"]
+        tx = optax.adamw(c["train_lr"])
+        opt = tx.init(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(model.apply({"params": p}, tokens), tokens)
+            )(params)
+            up, new_opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, up), new_opt, loss
+
+        for i in range(c["train_steps"]):
+            params, opt, loss = step(params, opt, batches[i % n_batches])
+        return params, float(loss)
+
+    tparams, tloss = train(target, 0)
+    dparams, dloss = train(draft, 1)
+    return target, tparams, tloss, draft, dparams, dloss
+
+
+def _spec_serve_trace():
+    """The pinned Poisson spec-serving trace: same arrival law as
+    ``_serve_trace`` but Markov-chain prompts (same table as the training
+    corpus), so generation follows learned structure and the accept rate
+    measures draft/target agreement."""
+    from dmlcloud_tpu.data import markov_tokens
+
+    c = _SERVE_SPEC_CFG
+    rs = np.random.RandomState(c["seed"])
+    offsets = np.cumsum(rs.exponential(c["mean_interarrival_s"], c["n_requests"]))
+    longest = max(c["prompt_lens"])
+    prompts = markov_tokens(c["vocab"], c["n_requests"], longest, seed=77, table_seed=0)
+    trace = []
+    for i in range(c["n_requests"]):
+        pl = c["prompt_lens"][i % len(c["prompt_lens"])]
+        new = c["new_tokens"][i % len(c["new_tokens"])]
+        trace.append((float(offsets[i]), prompts[i, :pl].astype(np.int32), int(new)))
+    return trace
+
+
+def _spec_serve_section():
+    """The speculative-serving A/B: the spec-decode engine (trained draft,
+    ``spec_k`` proposals/round) vs the SAME engine without speculation on
+    the same pinned trace and the same trained target — the composition
+    receipt ISSUE 10 asks for. Returns the results dict whose numbers feed
+    the ``serve_spec_*`` gate keys."""
+    from dmlcloud_tpu.models.generate import generate
+    from dmlcloud_tpu.serve import ServeEngine
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c = _SERVE_SPEC_CFG
+    target, tparams, tloss, draft, dparams, dloss = _spec_serve_models()
+    trace = _spec_serve_trace()
+
+    # serial greedy reference (identity only, not a timed arm — the PR-8
+    # receipt already locks engine-vs-serial)
+    serial_outs = [
+        np.asarray(generate(target, tparams, jnp.asarray(p)[None], n))[0]
+        for _, p, n in trace
+    ]
+
+    def engine_kw():
+        return dict(
+            num_blocks=c["num_blocks"], block_size=c["block_size"],
+            max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"],
+        )
+
+    def run_arm(**extra):
+        eng = ServeEngine(target, tparams, **engine_kw(), **extra)
+        eng.serve_trace([(0.0, p, n) for _, p, n in trace])  # warm: compile all
+        warm_outs = [eng.output(i) for i in range(len(trace))]
+        warm_sigs = eng.compiled_signatures()
+        eng.ledger = ServeLedger()
+        summary = eng.serve_trace(trace)
+        return eng, summary, warm_outs, warm_sigs
+
+    base_eng, base, _, _ = run_arm()
+    spec_eng, spec, spec_outs, spec_warm_sigs = run_arm(
+        spec_k=c["k"], draft_model=draft, draft_params=dparams
+    )
+    recompiles = spec_eng.compiled_signatures() - spec_warm_sigs
+
+    identical = all(
+        np.array_equal(w, s) for w, s in zip(spec_outs, serial_outs)
+    )
+    speedup = (
+        round(spec["tokens_per_sec"] / base["tokens_per_sec"], 3)
+        if spec["tokens_per_sec"] and base["tokens_per_sec"]
+        else None
+    )
+    rnd = lambda d: {
+        k: (round(v, 4) if isinstance(v, float) else v) for k, v in d.items()
+    }
+    return {
+        "config": dict(c),
+        "target_loss": round(tloss, 3),
+        "draft_loss": round(dloss, 3),
+        "engine": rnd(base),
+        "spec_engine": {
+            **rnd(spec),
+            "compiled_signatures": spec_eng.compiled_signatures(),
+            "max_signatures": spec_eng.max_signatures,
+            "target_pool": spec_eng.pool.stats(),
+            "draft_pool": spec_eng.draft_pool.stats(),
+        },
+        "speedup_tokens_per_sec": speedup,
+        "accept_rate": spec["accept_rate"],
+        "token_identical_to_serial": bool(identical),
+        "mid_run_recompiles": int(recompiles),
+    }
+
+
 def serve_child_main():
     """A/B the continuous-batching engine against serial ``generate()`` on
-    the pinned Poisson trace (CPU-pinned child); prints one marker line of
-    JSON — the source of ``BENCH_serve_*.json`` and of ``bench.py --gate
-    --suite serve``'s current numbers."""
+    the pinned Poisson trace, then the speculative engine against the
+    plain engine on the pinned Markov trace (CPU-pinned child); prints one
+    marker line of JSON — the source of ``BENCH_serve_*.json`` and of
+    ``bench.py --gate --suite serve``'s current numbers."""
     jax.config.update("jax_platforms", "cpu")
     from dmlcloud_tpu.serve import ServeEngine
     from dmlcloud_tpu.serve.ledger import ServeLedger
@@ -1090,6 +1254,7 @@ def serve_child_main():
         if summary["tokens_per_sec"] and serial["tokens_per_sec"]
         else None
     )
+    spec = _spec_serve_section()
     results = {
         "config": dict(c),
         "value_source": "cpu_smoke",
@@ -1101,11 +1266,21 @@ def serve_child_main():
         },
         "speedup_tokens_per_sec": speedup,
         "token_identical_to_serial": identical,
+        "spec": spec,
         # the flat, schema-stable section the perf gate compares
         "gate": {
             "serve_tokens_per_sec_speedup": speedup,
             "serve_engine_tokens_per_sec": summary["tokens_per_sec"],
             "serve_p99_ttft_s": summary["p99_ttft_s"],
+            # speculative-decode composition (ISSUE 10): speedup over the
+            # non-spec engine, accept-rate floor, greedy token-identity and
+            # the zero-mid-run-recompile contract as pass/fail ints
+            "serve_spec_speedup_vs_engine": spec["speedup_tokens_per_sec"],
+            "serve_spec_accept_rate": spec["accept_rate"],
+            "serve_spec_tokens_per_sec": spec["spec_engine"]["tokens_per_sec"],
+            "serve_spec_p99_ttft_s": spec["spec_engine"]["p99_ttft_s"],
+            "serve_spec_token_identical": int(bool(spec["token_identical_to_serial"])),
+            "serve_spec_zero_recompiles": int(spec["mid_run_recompiles"] == 0),
         },
     }
     print(_SERVE_MARKER + json.dumps(results), flush=True)
@@ -1370,6 +1545,7 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "elastic_save_on_preempt_latency_s",
         "elastic_time_to_resume_s",
         "serve_p99_ttft_s",
+        "serve_spec_p99_ttft_s",
         "data_wait_s",
     }
 )

@@ -15,6 +15,12 @@ contracts that keep them fast checkable on CPU:
           loop that reallocates the cache per request churns the biggest
           allocation in the program every iteration instead of reusing a
           pool (serve/kv_pool.py) or rewinding (generate.rewind_cache)
+- DML210  host readback of an on-device accept/round COUNTER inside a
+          serve/decode loop (``.item()``/``int()``/``np.asarray()`` on
+          accept counts per round) — the extra per-round device sync that
+          made the r05 speculative path 0.19×; counters must stay on
+          device or ride the loop's one token fetch (packed columns,
+          serve/engine.py's pattern)
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -45,7 +51,12 @@ from .engine import (
 )
 from .rules import _is_trainish
 
-__all__ = ["check_step_donation", "check_scan_remat", "check_cache_alloc_in_loop"]
+__all__ = [
+    "check_step_donation",
+    "check_scan_remat",
+    "check_cache_alloc_in_loop",
+    "check_counter_readback_in_loop",
+]
 
 
 def _f(ctx: ModuleCtx, rule_id: str, node: ast.AST, message: str, context: str = "") -> Finding:
@@ -298,6 +309,102 @@ def check_cache_alloc_in_loop(ctx: ModuleCtx):
                         "(generate.rewind_cache)",
                         getattr(fn, "name", ""),
                     )
+            yield from visit(
+                child, in_loop or isinstance(child, (ast.For, ast.AsyncFor, ast.While))
+            )
+
+    yield from visit(ctx.tree, False)
+
+
+# ------------------------------------------------------------------- DML210
+
+#: names that identify a speculative-decode / verification counter — the
+#: values a draft/verify round produces ON DEVICE (accept counts, round
+#: counters). Deliberately narrow: token fetches (the loop's one sanctioned
+#: sync) and generic values never match.
+_COUNTER_STEM = re.compile(r"(?i)(accept|n_acc|draft_count|drafted|n_rounds|rounds|num_rounds)")
+
+#: host-materialisation spellings DML210 watches inside loop bodies
+_READBACK_FNS = frozenset({"int", "float"})
+_READBACK_RESOLVED = frozenset({"numpy.asarray", "numpy.array", "jax.device_get"})
+
+
+def _counterish(expr: ast.AST) -> bool:
+    """Whether an expression names a counter: an identifier, attribute or
+    string key matching the counter vocabulary anywhere inside it."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and _COUNTER_STEM.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _COUNTER_STEM.search(sub.attr):
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) and _COUNTER_STEM.search(sub.value):
+            return True
+    return False
+
+
+def _counter_arg(arg: ast.AST, scopes) -> bool:
+    """``arg`` (the readback call's operand) names a counter — directly,
+    or after chasing a bare name to its binding through the dataflow core
+    (``acc = stats["accepted"]; int(acc)`` is the flow-aware case)."""
+    if _counterish(arg):
+        return True
+    if isinstance(arg, ast.Name):
+        bound = dataflow.resolve_expr(arg, scopes)
+        if bound is not None and bound is not arg:
+            return _counterish(bound)
+    return False
+
+
+@rule("DML210", "per-round host readback of an on-device counter in a serve/decode loop")
+def check_counter_readback_in_loop(ctx: ModuleCtx):
+    """A serve/decode loop that reads its accept/round counters back to
+    host EVERY iteration — ``counter.item()``, ``int(counter)``,
+    ``float(counter)``, ``np.asarray(counter)``, ``jax.device_get(counter)``
+    inside a ``for``/``while`` body — pays one extra device sync per
+    round on top of the loop's one sanctioned token fetch. That is the
+    exact regression that put the r05 speculative path at 0.19× plain:
+    per-round counter readbacks serialized every round against the
+    dispatch queue. Keep the counters on device across rounds, or pack
+    them into the same array the loop already fetches (the serving
+    engine returns ``[tokens | n_new | n_accept]`` as ONE fetch —
+    serve/engine.py). Flow-aware: a bare name is chased to its binding
+    (``acc = stats["accepted"]; int(acc)`` still fires); a readback
+    AFTER the loop — once per trace, not per round — never matches, and
+    functions *defined* inside the loop run at call time and are skipped
+    (DML107/DML208's exemption)."""
+
+    def hit(call: ast.Call):
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "item" and not call.args:
+            return _counter_arg(func.value, ctx.scopes_at(call))
+        arg = call.args[0] if call.args else None
+        if arg is None:
+            return False
+        if isinstance(func, ast.Name) and func.id in _READBACK_FNS and func.id not in ctx.aliases:
+            return _counter_arg(arg, ctx.scopes_at(call))
+        resolved = ctx.resolve(func) or ""
+        if resolved in _READBACK_RESOLVED:
+            return _counter_arg(arg, ctx.scopes_at(call))
+        return False
+
+    def visit(node: ast.AST, in_loop: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # the nested body executes when called, not per iteration
+                yield from visit(child, False)
+                continue
+            if in_loop and isinstance(child, ast.Call) and hit(child):
+                fn = ctx.enclosing_function(child)
+                yield _f(
+                    ctx, "DML210", child,
+                    "host readback of an on-device counter inside a serve/decode "
+                    "loop: one extra device sync per round (the r05 0.19x "
+                    "speculative regression); keep accept/round counters on "
+                    "device, or pack them into the loop's single token fetch "
+                    "(serve/engine.py returns [tokens | n_new | n_accept] as "
+                    "one array)",
+                    getattr(fn, "name", ""),
+                )
             yield from visit(
                 child, in_loop or isinstance(child, (ast.For, ast.AsyncFor, ast.While))
             )

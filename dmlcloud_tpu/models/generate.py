@@ -134,6 +134,60 @@ def sample_logits(logits, rng, temperature: float, top_k: int, top_p: float):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _truncate_scaled(logits, temperature, top_k, top_p):
+    """Per-row temperature/top-k/nucleus truncation with TRACED params.
+
+    ``logits`` is ``[B, V]`` or ``[B, T, V]``; ``temperature``/``top_k``/
+    ``top_p`` are ``[B]`` arrays (one value per row — the serving engine's
+    mixed-tenant case). Returns logits scaled and masked so their softmax
+    IS each row's sampling distribution, applying the SAME ops in the SAME
+    order as :func:`sample_logits` (scale, then top-k mask, then nucleus
+    mask) so a batch whose rows share one parameter set truncates
+    bit-identically to the scalar path. Rows with ``temperature == 0`` are
+    left at scale 1 (their caller takes the argmax; the division must
+    merely stay finite), ``top_k <= 0`` / ``top_p >= 1`` disable the
+    respective mask per row — every knob is data, nothing recompiles."""
+    v = logits.shape[-1]
+    extra = logits.ndim - 2  # 0 for [B, V], 1 for [B, T, V]
+    bshape = (-1,) + (1,) * (extra + 1)
+    temperature = jnp.reshape(temperature, bshape)
+    top_k = jnp.reshape(top_k, bshape)
+    top_p = jnp.reshape(top_p, bshape)
+    x = logits / jnp.where(temperature > 0, temperature, 1.0)
+    # top-k: the row's k-th largest value is the cut (k clamped into [1, V]
+    # so the disabled rows still index validly; their mask is dropped)
+    sorted_desc = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k, 1, v) - 1, axis=-1
+    )
+    x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
+    # nucleus: smallest prefix of the sorted distribution reaching top_p
+    # (sample_logits' clamp semantics — the first token always survives)
+    sx = jnp.flip(jnp.sort(x, axis=-1), axis=-1)
+    csum = jnp.cumsum(jax.nn.softmax(sx, axis=-1), axis=-1)
+    cutoff_idx = jnp.minimum(
+        jnp.sum(csum < top_p, axis=-1, keepdims=True), v - 1
+    )
+    cutoff = jnp.take_along_axis(sx, cutoff_idx, axis=-1)
+    return jnp.where((top_p < 1.0) & (x < cutoff), -jnp.inf, x)
+
+
+def sample_logits_batched(logits, rng, temperature, top_k, top_p):
+    """Per-row traced twin of :func:`sample_logits`: ``logits`` is
+    ``[B, V]`` fp32, the sampling params are ``[B]`` arrays so ONE
+    compiled program serves mixed greedy/sampled tenants (the serving
+    engine's batched-sampling contract). Rows with ``temperature == 0``
+    return the exact argmax — bit-identical to the scalar greedy path —
+    and a batch whose rows all carry one parameter set samples the same
+    tokens as ``sample_logits`` with those scalars (same rng, same masked
+    logits, same categorical)."""
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    x = _truncate_scaled(logits, temperature, top_k, top_p)
+    sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id", "pad_id"),

@@ -61,11 +61,95 @@ import jax.numpy as jnp
 
 from .transformer import DecoderLM
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "verify_proposals"]
 
 
 def _greedy(logits):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def verify_proposals(tlogits, dlogits, proposals, rng, temperature, top_k, top_p, eos_id):
+    """The batched accept rule — one verification round for ``B`` rows
+    with PER-ROW sampling params (the serving engine's spec-decode step;
+    the single-row loop above is the same math specialised to B=1 and one
+    static greedy/sampled switch).
+
+    ``tlogits`` is the target's ``[B, k+1, V]`` verification logits over
+    ``[y_last, d_1..d_k]``; ``dlogits`` is ``[B, k, V]`` — row ``i`` is
+    the TRUNCATED, SCALED draft distribution ``d_{i+1}`` was sampled from
+    (``generate._truncate_scaled`` output; for greedy rows the values are
+    never read); ``proposals`` is ``[B, k]``; ``temperature``/``top_k``/
+    ``top_p``/``eos_id`` are ``[B]`` traced arrays. Rows with
+    ``temperature == 0`` take the greedy rule (longest matching prefix +
+    the target's correction token — committed tokens are exactly what
+    greedy ``generate`` would emit); rows with ``temperature > 0`` run
+    rejection sampling against their OWN truncated distributions, which
+    preserves each row's truncated target sampling distribution exactly.
+
+    Returns ``(new_tokens [B, k+1], n_new [B], n_accept [B])`` int32:
+    tokens to commit (positions ``>= n_new`` are meaningless), how many
+    to commit this round (``>= 1``; truncated at a row's own eos), and
+    the exact count of verifier-accepted proposals (the accept-rate
+    numerator; drafted is always ``k``)."""
+    from .generate import _truncate_scaled
+
+    b, kp1, _ = tlogits.shape
+    k = kp1 - 1
+    temperature = jnp.asarray(temperature, jnp.float32)
+    ar = jnp.arange(k + 1)[None, :]  # [1, k+1]
+    no = jnp.zeros((b, 1), bool)
+
+    # --- greedy rule: longest matching prefix + correction ---
+    greedy_tok = _greedy(tlogits)  # [B, k+1]
+    match = proposals == greedy_tok[:, :k]
+    n_acc_g = jnp.argmin(jnp.concatenate([match, no], axis=1), axis=1)
+    new_g = jnp.where(ar <= n_acc_g[:, None], greedy_tok, 0)
+
+    # --- rejection sampling (Leviathan et al. 2023), per-row params ---
+    tlp = jax.nn.log_softmax(
+        _truncate_scaled(tlogits.astype(jnp.float32), temperature, top_k, top_p), axis=-1
+    )  # [B, k+1, V]
+    # (k+1)-th draft row is an indexing placeholder — selected only when
+    # every proposal was accepted, where probs comes from p_t alone
+    dlp = jax.nn.log_softmax(
+        jnp.concatenate(
+            [dlogits.astype(jnp.float32), jnp.zeros_like(dlogits[:, :1])], axis=1
+        ),
+        axis=-1,
+    )
+    lp_t = jnp.take_along_axis(tlp[:, :k], proposals[..., None], axis=-1)[..., 0]
+    lp_d = jnp.take_along_axis(dlp[:, :k], proposals[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(rng, (b, k))
+    accept = jnp.log(u) < jnp.minimum(lp_t - lp_d, 0.0)
+    n_acc_s = jnp.argmin(jnp.concatenate([accept, no], axis=1), axis=1)
+    p_t = jnp.exp(jnp.take_along_axis(tlp, n_acc_s[:, None, None], axis=1)[:, 0])  # [B, V]
+    p_d = jnp.exp(jnp.take_along_axis(dlp, n_acc_s[:, None, None], axis=1)[:, 0])
+    residual = jnp.maximum(p_t - p_d, 0.0)
+    probs = jnp.where((n_acc_s == k)[:, None], p_t, residual)
+    probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-30)
+    final_tok = jax.random.categorical(
+        jax.random.fold_in(rng, 1), jnp.log(probs + 1e-30), axis=-1
+    ).astype(jnp.int32)
+    prop_pad = jnp.concatenate([proposals, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    new_s = jnp.where(
+        ar < n_acc_s[:, None], prop_pad,
+        jnp.where(ar == n_acc_s[:, None], final_tok[:, None], 0),
+    )
+
+    sampled = temperature > 0
+    n_accept = jnp.where(sampled, n_acc_s, n_acc_g).astype(jnp.int32)
+    new_tokens = jnp.where(sampled[:, None], new_s, new_g).astype(jnp.int32)
+
+    # a row's own eos truncates its round: tokens strictly after the first
+    # eos never commit, and the advance stops at the eos inclusive
+    is_eos = new_tokens == eos_id[:, None]
+    seen_eos = jnp.cumsum(is_eos, axis=1) - is_eos.astype(jnp.int32) > 0
+    hit_eos = jnp.any(is_eos & ~seen_eos & (ar <= n_accept[:, None]), axis=1)
+    n_new = jnp.minimum(
+        n_accept + 1,
+        jnp.where(hit_eos, jnp.argmax(is_eos & ~seen_eos, axis=1) + 1, k + 1),
+    ).astype(jnp.int32)
+    return new_tokens, n_new, n_accept
 
 
 def _row_spec_decode(

@@ -19,14 +19,24 @@ arithmetic that makes the pool usable from inside a jitted decode step:
   ``_window_keep`` — the Mistral-convention machinery the flash kernels in
   ``ops/flash_attention.py`` block-tile).
 
+Both functions are multi-token per row by construction — ``T`` is just a
+shape axis. Chunked prefill writes ``prefill_chunk`` positions per call,
+and the speculative engine's rounds lean on the same property: a draft
+pass writes 2 then 1 positions, the verification pass scatters all
+``k+1`` proposal positions per sequence through the block tables in ONE
+call (and gathers once for the whole round) — the multi-token round cost
+that replaces plain decode's per-token cost (serve/engine.py).
+
 Out-of-range handling is the whole trick for static shapes: block tables
 are padded with a SENTINEL entry equal to ``num_blocks`` (one past the
 pool). jax clips out-of-bounds *gather* indices — the sentinel reads the
 last real block, and the caller's ``kv_pos <= q_pos`` mask hides whatever
 it read — and ``mode="drop"`` discards out-of-bounds *scatter* updates, so
 a padded batch row (or a prefill chunk's padded tail spilling past its
-allocation) writes nothing at all. Inactive rows therefore cost index
-arithmetic only; no branch, no dynamic shape.
+allocation) writes nothing at all. A NEGATIVE position maps below the
+table and is redirected to the sentinel the same way — it can never wrap
+into a real block (tests/test_serve.py locks both). Inactive rows
+therefore cost index arithmetic only; no branch, no dynamic shape.
 """
 
 from __future__ import annotations
@@ -59,9 +69,9 @@ def scatter_tokens(
     lands in logical block ``p // block_size``, slot ``p % block_size``);
     ``values`` is ``[B, T, KH, D]``. A position whose logical block falls
     outside its table row — a padded batch row carrying a sentinel-only
-    table, or a prefill pad tail past the row's allocation — maps to the
-    out-of-bounds sentinel and is DROPPED by the scatter, not written.
-    Returns the updated pool.
+    table, a prefill pad tail past the row's allocation, or a negative
+    position — maps to the out-of-bounds sentinel and is DROPPED by the
+    scatter, not written. Returns the updated pool.
     """
     num_blocks, block_size = pool.shape[0], pool.shape[1]
     nb = tables.shape[1]

@@ -11,12 +11,16 @@ static batch per call; this package turns it into a serving engine:
   no starvation.
 - :class:`ServeEngine` (engine.py): the loop — bucketed decode shapes
   (0 mid-run recompiles, TraceGuard-enforced), greedy output
-  token-identical to serial ``generate()``.
+  token-identical to serial ``generate()``; per-REQUEST sampling params
+  (mixed greedy/sampled tenants in one batch) and speculative decoding
+  (``spec_k`` draft proposals per round against a second page pool, one
+  k+1-position verify pass, partial-accept rewind by fill counters).
 - :class:`AdapterSet` (adapters.py): multi-tenant LoRA serving, one base
   model + per-request adapter deltas inside the decode step.
 - :class:`ServeLedger` (ledger.py): TTFT / per-token / queue-depth
-  latency accounting, journal span kinds ``queue_wait`` / ``prefill`` /
-  ``decode_batch``.
+  latency accounting plus drafted/accepted counters and accept rates,
+  journal span kinds ``queue_wait`` / ``prefill`` / ``decode_batch`` /
+  ``draft`` / ``verify``.
 
 Quick start::
 

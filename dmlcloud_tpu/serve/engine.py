@@ -2,32 +2,65 @@
 
 One :class:`ServeEngine` owns the four pieces the module docstrings around
 it describe — the device page pool (``kv_pool``), the FIFO scheduler
-(``scheduler``), the per-request latency ledger (``ledger``) and ONE
-jitted paged decode step — and runs the serving loop:
+(``scheduler``), the per-request latency ledger (``ledger``) and the
+jitted paged steps — and runs the serving loop:
 
     admit waiting requests -> one prefill chunk -> one decode batch
 
-per :meth:`step`. The decode batch advances EVERY running stream by one
-token regardless of how much prefill is pending, so a long prompt never
+per :meth:`step`. The decode batch advances EVERY running stream
+regardless of how much prefill is pending, so a long prompt never
 stalls running generations; a stream that emits EOS frees its slot and
 blocks before the next step, and the next waiting request takes them —
 continuous batching, no drain barrier.
 
+**Two decode modes share that loop:**
+
+- *Plain* (``spec_k == 0``): one jitted ``_paged_step`` advances every
+  row one token per step — the PR-8 engine, unchanged semantics.
+- *Speculative* (``spec_k >= 1``): a draft model (or the target itself —
+  shared-model self-draft, ``models/speculative.py``'s smoke config)
+  proposes ``k`` tokens per round against its OWN page pool, and one
+  verifier pass scores all ``k+1`` positions per row through the same
+  ``ops/paged_attention.py`` scatter/gather (multi-token writes through
+  the block tables; sentinel rows still drop). The accept rule is
+  :func:`models.speculative.verify_proposals` with each row's own
+  sampling params; a partial accept "rewinds" by advancing the host-side
+  fill counters only to the accepted position — block ownership never
+  moves, and the next round's contiguous writes overwrite the stale
+  speculative tail before the causal mask can expose it (the same
+  overwrite invariant ``speculative_generate`` proves). Per accepted
+  token the target pays ``~1/(accepted+1)`` of a weight-streaming pass —
+  the per-token cost of the weight-bandwidth-bound decode loop becomes a
+  per-round cost.
+
+**Per-request sampling.** ``temperature``/``top_k``/``top_p``/``eos_id``
+ride each :class:`Request` and enter the compiled steps as per-row traced
+arrays (``models.generate.sample_logits_batched``), so one engine serves
+mixed greedy/sampled tenants in a single batch; greedy rows stay
+bit-identical to serial ``generate()``.
+
 **Zero mid-run recompiles, by construction.** Every device call's shape
-signature is ``(batch_bucket, table_bucket)`` for decode and
-``(1, prefill_chunk, table_bucket)`` for prefill, with both bucket sets
-fixed at engine construction (``compile/buckets.py`` machinery — the same
-bounded-signature contract the training loop's ragged batches use). The
-jitted step is wrapped in a ``TraceGuard`` armed at exactly the bucket
-product, so a signature leak is a raised ``RetraceError`` in tests rather
-than a silent compile stall under production traffic.
+signature is ``(batch_bucket, table_bucket)`` for decode (each of the
+draft and verify steps in spec mode) and ``(1, prefill_chunk,
+table_bucket)`` for prefill — times two prefill models in spec mode —
+with both bucket sets fixed at engine construction (``compile/buckets.py``
+machinery). Each jitted step is wrapped in a ``TraceGuard`` armed at
+exactly its bucket product, so a signature leak is a raised
+``RetraceError`` in tests rather than a silent compile stall under
+production traffic.
+
+**One host sync per device round.** The fetched array IS the output
+(tokens), and in spec mode the per-row ``n_new``/``n_accept`` counters
+ride THAT SAME fetch as two extra packed columns — no separate
+``.item()``/``int()`` readback of accept counters anywhere in the loop
+(lint rule DML210 exists because a per-round counter readback is exactly
+the host sync that made the r05 speculative path 0.19×).
 
 The decode math itself is :func:`models.generate.decode_step` — the same
 primitive ``generate``/``beam_search``/``speculative_generate`` run — with
 ``pages=(block_tables, fill)`` steering it through the pool
-(``ops/paged_attention.py``), so greedy engine output is token-identical
-to serial ``generate()`` of the same prompts. ``prepare_decode_params`` is
-applied once at construction: int8 weight-only trees serve with the
+(``ops/paged_attention.py``). ``prepare_decode_params`` is applied once at
+construction for both models: int8 weight-only trees serve with the
 fused-dequant kernels and the off-TPU operand widen pre-paid (the PR-6
 decode win), with no per-call preparation left in the loop.
 """
@@ -55,21 +88,88 @@ __all__ = ["ServeEngine"]
 
 def _paged_step(
     pools, params, tables, fill, tokens, last_idx, rng, adapters,
-    *, model, temperature, top_k, top_p,
+    temperature, top_k, top_p, *, model,
 ):
-    """One traced engine step (prefill chunk or decode batch): write
+    """One traced engine step (prefill chunk or plain decode batch): write
     ``tokens``' K/V through the block tables, read each row's logits at
-    ``last_idx`` and sample the next token. ``pools`` is donated — the
-    engine swaps in the returned pages (DML205: never two live copies of
-    the cache)."""
-    from ..models.generate import decode_step, sample_logits
+    ``last_idx`` and sample the next token with each ROW's params (traced
+    ``[B]`` arrays — mixed greedy/sampled tenants share the trace, and a
+    new temperature never recompiles). ``pools`` is donated — the engine
+    swaps in the returned pages (DML205: never two live copies of the
+    cache)."""
+    from ..models.generate import decode_step, sample_logits_batched
 
     logits, pools = decode_step(
         model, params, tokens, pools, pages=(tables, fill), adapters=adapters
     )
     last = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]  # [B, V]
-    tok = sample_logits(last, rng, temperature, top_k, top_p)
+    tok = sample_logits_batched(last, rng, temperature, top_k, top_p)
     return tok, pools
+
+
+def _spec_draft_step(
+    pools, params, tables, fill, prev_tok, last_tok, rng,
+    temperature, top_k, top_p, *, model, k,
+):
+    """The draft half of one speculative round: ``k`` proposals per row
+    against the draft page pool, all shapes static. Pass 0 feeds the last
+    TWO committed tokens at positions ``fill-1``/``fill`` — the leading
+    rewrite closes the draft pool's one-slot gap after a fully-accepted
+    round (``models/speculative.py``'s 2-token trick) and is an identical
+    rewrite otherwise; passes ``1..k-1`` feed each proposal at
+    ``fill + i``. Returns ``(proposals [B, k], dlogits [B, k, V],
+    pools)`` where ``dlogits`` row ``i`` is the truncated, scaled
+    distribution proposal ``i+1`` was sampled from — exactly what the
+    verifier's rejection rule needs as ``p_d``. ``pools`` is donated."""
+    from ..models.generate import _truncate_scaled, decode_step, sample_logits_batched
+
+    def pick(row_logits, i):
+        return sample_logits_batched(
+            row_logits, jax.random.fold_in(rng, i), temperature, top_k, top_p
+        )
+
+    toks2 = jnp.stack([prev_tok, last_tok], axis=1)  # [B, 2]
+    logits, pools = decode_step(model, params, toks2, pools, pages=(tables, fill - 1))
+    nxt = pick(logits[:, -1], 0)
+    props, drows = [nxt], [logits[:, -1]]
+    for i in range(1, k):  # k-1 single-token passes (unrolled: k is static)
+        logits, pools = decode_step(
+            model, params, nxt[:, None], pools, pages=(tables, fill + i)
+        )
+        nxt = pick(logits[:, 0], i)
+        props.append(nxt)
+        drows.append(logits[:, 0])
+    proposals = jnp.stack(props, axis=1)  # [B, k]
+    dlogits = _truncate_scaled(
+        jnp.stack(drows, axis=1).astype(jnp.float32), temperature, top_k, top_p
+    )
+    return proposals, dlogits, pools
+
+
+def _spec_verify_step(
+    pools, params, tables, fill, last_tok, proposals, dlogits, rng,
+    temperature, top_k, top_p, eos_id, *, model, k,
+):
+    """The verify half: ONE target pass scores all ``k+1`` positions per
+    row (``[y_last, d_1..d_k]`` written at ``fill..fill+k`` through the
+    block tables), then :func:`models.speculative.verify_proposals` runs
+    each row's own accept rule. Returns ``(packed [B, k+3], pools)`` —
+    the ``k+1`` tokens to commit plus the ``n_new``/``n_accept`` counters
+    as two extra columns, so ONE host fetch carries tokens AND counters
+    (no separate counter readback per round — DML210). ``pools`` is
+    donated."""
+    from ..models.generate import decode_step
+    from ..models.speculative import verify_proposals
+
+    x = jnp.concatenate([last_tok[:, None], proposals], axis=1)  # [B, k+1]
+    tlogits, pools = decode_step(model, params, x, pools, pages=(tables, fill))
+    new_tokens, n_new, n_accept = verify_proposals(
+        tlogits, dlogits, proposals, rng, temperature, top_k, top_p, eos_id
+    )
+    packed = jnp.concatenate(
+        [new_tokens, n_new[:, None], n_accept[:, None]], axis=1
+    )
+    return packed, pools
 
 
 def _pow2_buckets(limit: int) -> tuple[int, ...]:
@@ -94,11 +194,20 @@ class ServeEngine:
     - ``max_slots``: concurrent decode streams; ``batch_buckets`` /
       ``table_buckets`` default to powers of two capped at the maxima.
     - ``prefill_chunk``: prompt tokens processed per engine step.
-    - sampling (``temperature``/``top_k``/``top_p``/``eos_id``) is
-      engine-level: one compiled sampler for every request (greedy
-      default, ``generate()`` semantics).
+    - sampling (``temperature``/``top_k``/``top_p``/``eos_id``): the
+      ENGINE DEFAULTS (greedy, ``generate()`` semantics); each request
+      may override any of them (``submit``), and the per-row values ride
+      the compiled step as traced arrays.
+    - ``spec_k``: speculative proposals per verification round; 0 (the
+      default) is the plain one-token-per-step engine. ``draft_model`` /
+      ``draft_params`` name the proposer (both None = shared-model
+      self-draft: the target drafts for itself — the correctness smoke,
+      accept rate exactly 1.0 under greedy); ``draft_num_blocks`` sizes
+      the draft page pool (default: the target pool's count).
     - ``adapters``: an :class:`AdapterSet` for multi-tenant LoRA serving;
-      requests pick a tenant by name.
+      requests pick a tenant by name (plain mode only for now — the
+      draft would propose without the tenant's delta, collapsing the
+      accept rate).
     - ``guard``: ``TraceGuard`` action on a signature leak ("raise"/"warn").
     """
 
@@ -117,6 +226,10 @@ class ServeEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         eos_id: int = -1,
+        spec_k: int = 0,
+        draft_model=None,
+        draft_params: Any = None,
+        draft_num_blocks: int | None = None,
         adapters: AdapterSet | None = None,
         rng: jax.Array | None = None,
         guard: str = "raise",
@@ -124,18 +237,49 @@ class ServeEngine:
     ):
         from ..models.quant import prepare_decode_params
 
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if (draft_model is None) != (draft_params is None):
+            raise ValueError("draft_model and draft_params must be passed together")
+        if draft_model is not None and spec_k < 1:
+            raise ValueError("a draft model needs spec_k >= 1")
+        if spec_k and adapters is not None:
+            raise ValueError(
+                "speculative decoding with per-request adapters is not supported: "
+                "the draft would propose without the tenant's delta"
+            )
         self.model = model
         cfg = model.cfg
         # one-time host-side preparation: int8 kernels stay fused-quantized
         # and the off-TPU GEMM-operand widen is pre-paid (models/quant.py)
         self.params = prepare_decode_params(params, cfg.dtype)
+        self.spec_k = int(spec_k)
         max_table = -(-cfg.max_seq_len // block_size)
         if num_blocks is None:
             num_blocks = max_slots * max_table
         self.pool = KVBlockPool.for_model(
             cfg, num_blocks=num_blocks, block_size=block_size, dtype=cache_dtype
         )
-        self.scheduler = Scheduler(self.pool, max_slots, prefill_chunk)
+        self.draft_model = None
+        self.draft_params = None
+        self.draft_pool = None
+        if self.spec_k:
+            # shared-model self-draft unless a real draft is named; either
+            # way the draft owns its OWN page pool — rollback is a fill
+            # counter, never shared pages
+            self.draft_model = draft_model if draft_model is not None else model
+            dparams = draft_params if draft_params is not None else params
+            self.draft_params = prepare_decode_params(dparams, self.draft_model.cfg.dtype)
+            self.draft_pool = KVBlockPool.for_model(
+                self.draft_model.cfg,
+                num_blocks=int(draft_num_blocks or num_blocks),
+                block_size=block_size,
+                dtype=cache_dtype,
+            )
+        self.scheduler = Scheduler(
+            self.pool, max_slots, prefill_chunk,
+            draft_pool=self.draft_pool, lookahead=self.spec_k,
+        )
         self.ledger = ServeLedger()
         self.adapters = adapters
         self.eos_id = int(eos_id)
@@ -154,39 +298,70 @@ class ServeEngine:
         self.table_buckets = (
             resolve_buckets(table_buckets) if table_buckets else _pow2_buckets(table_cap)
         )
-        #: the engine's whole compiled-signature budget: decode is
-        #: (batch bucket x table bucket), prefill is (1, chunk) x table
-        #: bucket. TraceGuard turns any growth past this into an error.
-        self.max_signatures = (
-            len(self.batch_buckets) * len(self.table_buckets) + len(self.table_buckets)
-        )
+        n_bb, n_tb = len(self.batch_buckets), len(self.table_buckets)
         # per-engine jit: jax keys its trace cache on the function OBJECT,
         # so a fresh partial per engine gives each engine its own cache —
         # the TraceGuard budget is then this engine's alone, not the
         # process-wide total across every engine ever built
-        self._step_fn = TraceGuard(
-            jax.jit(
-                functools.partial(_paged_step),
-                static_argnames=("model", "temperature", "top_k", "top_p"),
-                donate_argnums=(0,),
-            ),
-            max_traces=self.max_signatures,
-            action=guard,
-            name="serve_paged_step",
-        )
+        def _guarded(fn, budget, name, donate=(0,)):
+            return TraceGuard(
+                jax.jit(
+                    functools.partial(fn),
+                    static_argnames=("model",) + (("k",) if fn is not _paged_step else ()),
+                    donate_argnums=donate,
+                ),
+                max_traces=budget, action=guard, name=name,
+            )
+
+        if self.spec_k:
+            #: spec-mode signature budget: prefill is (1, chunk) x table
+            #: bucket x {target, draft} through _paged_step; each decode
+            #: round is one draft + one verify signature per (batch bucket
+            #: x table bucket). TraceGuard turns any growth into an error.
+            self._step_budget = 2 * n_tb
+            self._spec_budget = n_bb * n_tb
+            self.max_signatures = self._step_budget + 2 * self._spec_budget
+            self._draft_fn = _guarded(_spec_draft_step, self._spec_budget, "serve_spec_draft")
+            self._verify_fn = _guarded(_spec_verify_step, self._spec_budget, "serve_spec_verify")
+        else:
+            #: the engine's whole compiled-signature budget: decode is
+            #: (batch bucket x table bucket), prefill is (1, chunk) x table
+            #: bucket.
+            self._step_budget = n_bb * n_tb + n_tb
+            self.max_signatures = self._step_budget
+            self._draft_fn = self._verify_fn = None
+        self._step_fn = _guarded(_paged_step, self._step_budget, "serve_paged_step")
 
     # -- request lifecycle ---------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int = 32, adapter: str | None = None) -> int:
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        adapter: str | None = None,
+        *,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        eos_id: int | None = None,
+    ) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D int32
         token sequence (no padding — paged rows sit at their own absolute
-        positions, ragged prompts are the natural case)."""
+        positions, ragged prompts are the natural case). The sampling
+        knobs override the engine defaults FOR THIS REQUEST ONLY — they
+        are data to the compiled step, so a batch may mix greedy and
+        sampled tenants freely."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
-        if prompt.size + int(max_new_tokens) > self.model.cfg.max_seq_len:
+        # spec rounds may write up to k proposals past the final committed
+        # slot (plus the bonus slot) — the same slack speculative_generate
+        # reserves; plain decode keeps the exact PR-8 bound
+        slack = self.spec_k + 1 if self.spec_k else 0
+        if prompt.size + int(max_new_tokens) + slack > self.model.cfg.max_seq_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_seq_len ({self.model.cfg.max_seq_len})"
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens})"
+                + (f" + spec_k+1 ({slack})" if slack else "")
+                + f" exceeds max_seq_len ({self.model.cfg.max_seq_len})"
             )
         aid = 0
         if adapter is not None:
@@ -197,9 +372,16 @@ class ServeEngine:
         rid = self._next_id
         self._next_id += 1
         req = Request(
-            prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter, id=rid
+            prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter,
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id, id=rid,
         )
-        seq = _Sequence(req=req, arrival=now, adapter_id=aid)
+        seq = _Sequence(
+            req=req, arrival=now, adapter_id=aid,
+            temperature=self._temperature if temperature is None else float(temperature),
+            top_k=self._top_k if top_k is None else int(top_k),
+            top_p=self._top_p if top_p is None else float(top_p),
+            eos_id=self.eos_id if eos_id is None else int(eos_id),
+        )
         self.ledger.arrived(rid, now)
         self.scheduler.submit(seq)
         return rid
@@ -216,13 +398,23 @@ class ServeEngine:
         return self.scheduler.idle
 
     def compiled_signatures(self) -> int | None:
-        """Distinct compiled signatures so far (the TraceGuard probe)."""
-        return self._step_fn.cache_size()
+        """Distinct compiled signatures so far, summed over the engine's
+        jitted steps (the TraceGuard probes)."""
+        total = 0
+        for fn in (self._step_fn, self._draft_fn, self._verify_fn):
+            if fn is None:
+                continue
+            n = fn.cache_size()
+            if n is None:
+                return None
+            total += n
+        return total
 
     # -- the serving loop ----------------------------------------------------
     def step(self) -> bool:
         """One engine iteration: admit, one prefill chunk, one decode
-        batch. Returns whether any device work ran."""
+        batch (a speculative round when ``spec_k``). Returns whether any
+        device work ran."""
         now = time.perf_counter()
         for seq in self.scheduler.admit(now):
             self.ledger.admitted(seq.req.id, now)
@@ -235,7 +427,10 @@ class ServeEngine:
             did = True
         batch = self.scheduler.decode_batch()
         if batch:
-            self._decode(batch)
+            if self.spec_k:
+                self._decode_spec(batch)
+            else:
+                self._decode(batch)
             did = True
         return did
 
@@ -272,27 +467,48 @@ class ServeEngine:
         return self.ledger.summary()
 
     # -- device calls --------------------------------------------------------
-    def _call(self, tables, fill, tokens, last_idx, ids):
+    def _next_rng(self):
         self._calls += 1
-        rng = jax.random.fold_in(self._rng, self._calls)
+        return jax.random.fold_in(self._rng, self._calls)
+
+    def _row_params(self, seqs, bb: int):
+        """The per-row sampling-param arrays of a (padded) batch. Pad rows
+        get the greedy defaults — their samples are discarded, the values
+        only need to keep the traced math finite."""
+        temps = np.zeros(bb, np.float32)
+        topks = np.zeros(bb, np.int32)
+        topps = np.ones(bb, np.float32)
+        eos = np.full(bb, -1, np.int32)
+        for i, s in enumerate(seqs):
+            temps[i] = s.temperature
+            topks[i] = s.top_k
+            topps[i] = s.top_p
+            eos[i] = s.eos_id
+        return (
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(eos)
+        )
+
+    def _call(self, pool, model, params, tables, fill, tokens, last_idx, ids, row_params):
+        temps, topks, topps, _ = row_params
         adapters = None
         if self.adapters is not None:
             adapters = (self.adapters.stacked, jnp.asarray(ids, jnp.int32))
         tok, new_pools = self._step_fn(
-            self.pool.pools, self.params,
+            pool.pools, params,
             jnp.asarray(tables, jnp.int32), jnp.asarray(fill, jnp.int32),
             jnp.asarray(tokens, jnp.int32), jnp.asarray(last_idx, jnp.int32),
-            rng, adapters,
-            model=self.model, temperature=self._temperature,
-            top_k=self._top_k, top_p=self._top_p,
+            self._next_rng(), adapters, temps, topks, topps,
+            model=model,
         )
-        self.pool.swap(new_pools)
+        pool.swap(new_pools)
         return np.asarray(tok)  # the per-step host sync: tokens ARE the output
 
-    def _table_rows(self, seqs, nb: int) -> np.ndarray:
-        rows = np.full((len(seqs), nb), self.pool.sentinel, np.int32)
+    def _table_rows(self, seqs, nb: int, draft: bool = False) -> np.ndarray:
+        pool = self.draft_pool if draft else self.pool
+        rows = np.full((len(seqs), nb), pool.sentinel, np.int32)
         for i, s in enumerate(seqs):
-            blocks = s.blocks[: min(len(s.blocks), nb)]
+            owned = s.draft_blocks if draft else s.blocks
+            blocks = owned[: min(len(owned), nb)]
             rows[i, : len(blocks)] = blocks
         return rows
 
@@ -303,20 +519,36 @@ class ServeEngine:
         tokens[0, :n] = seq.req.prompt[seq.fill : seq.fill + n]
         nb = bucket_for(self.pool.blocks_for(seq.fill + n), self.table_buckets)
         final = seq.fill + n >= seq.prompt_len
+        row_params = self._row_params([seq], 1)
+        fill = np.asarray([seq.fill], np.int32)
+        last = np.asarray([n - 1], np.int32)
         t0 = journal.now()
         tok = self._call(
-            self._table_rows([seq], nb), np.asarray([seq.fill], np.int32), tokens,
-            np.asarray([n - 1], np.int32), [seq.adapter_id],
+            self.pool, self.model, self.params,
+            self._table_rows([seq], nb), fill, tokens, last,
+            [seq.adapter_id], row_params,
         )
-        seq.fill += n
         journal.emit("prefill", t0, label=f"req{seq.req.id}", request=seq.req.id,
-                     chunk=n, fill=seq.fill, blocks=nb)
+                     chunk=n, fill=seq.fill + n, blocks=nb)
+        if self.spec_k:
+            # the draft pool needs the same prompt K/V: one mirrored chunk
+            # through the draft model (its sampled token is discarded)
+            t1 = journal.now()
+            self._call(
+                self.draft_pool, self.draft_model, self.draft_params,
+                self._table_rows([seq], nb, draft=True), fill, tokens, last,
+                [seq.adapter_id], row_params,
+            )
+            journal.emit("draft", t1, label=f"req{seq.req.id}:prefill",
+                         request=seq.req.id, chunk=n, blocks=nb)
+        seq.fill += n
         if final:
             # the last real prompt position's logits ARE the first token —
             # time-to-first-token ends here, before any decode step
             now = time.perf_counter()
             self.ledger.first_token(seq.req.id, now)
             self.scheduler.prefill_done(seq)
+            seq.prev_token = int(seq.req.prompt[-1])
             self._emit(seq, int(tok[0]), now)
 
     def _decode(self, batch) -> None:
@@ -332,8 +564,12 @@ class ServeEngine:
             fill[i] = s.fill
             tokens[i, 0] = s.last_token
             ids[i] = s.adapter_id
+        row_params = self._row_params(batch, bb)
         t0 = journal.now()
-        tok = self._call(tables, fill, tokens, np.zeros(bb, np.int32), ids)
+        tok = self._call(
+            self.pool, self.model, self.params, tables, fill, tokens,
+            np.zeros(bb, np.int32), ids, row_params,
+        )
         now = time.perf_counter()
         journal.emit("decode_batch", t0, label=f"b{bb}", active=len(batch),
                      bucket=bb, blocks=nb)
@@ -342,10 +578,77 @@ class ServeEngine:
             s.fill += 1  # the fed token's K/V landed at its position
             self._emit(s, int(tok[i]), now)
 
+    def _decode_spec(self, batch) -> None:
+        """One speculative round for the whole decode batch: k draft
+        passes, one k+1-position verify, then the host commits each row's
+        accepted prefix. The partial-accept rewind is exactly the
+        ``fill += n_new`` below — fill counters roll forward only to the
+        accepted position; the stale speculative K/V past it is
+        overwritten by the next round's contiguous writes before the
+        causal mask can expose it, and block ownership never changes."""
+        k = self.spec_k
+        bb = bucket_for(len(batch), self.batch_buckets)
+        needed = max(
+            s.needed_blocks(self.pool.block_size, lookahead=k) for s in batch
+        )
+        nb = bucket_for(needed, self.table_buckets)
+        tables = np.full((bb, nb), self.pool.sentinel, np.int32)
+        tables[: len(batch)] = self._table_rows(batch, nb)
+        dtables = np.full((bb, nb), self.draft_pool.sentinel, np.int32)
+        dtables[: len(batch)] = self._table_rows(batch, nb, draft=True)
+        # pad rows: fill=1 keeps every traced position >= 0 and the
+        # attention mask non-empty; their sentinel tables drop all writes
+        fill = np.ones(bb, np.int32)
+        prev = np.zeros(bb, np.int32)
+        last = np.zeros(bb, np.int32)
+        for i, s in enumerate(batch):
+            fill[i] = s.fill
+            prev[i] = s.prev_token
+            last[i] = s.last_token
+        temps, topks, topps, eos = self._row_params(batch, bb)
+        tables = jnp.asarray(tables, jnp.int32)
+        dtables = jnp.asarray(dtables, jnp.int32)
+        fill = jnp.asarray(fill, jnp.int32)
+        prev = jnp.asarray(prev, jnp.int32)
+        last = jnp.asarray(last, jnp.int32)
+
+        t0 = journal.now()
+        proposals, dlogits, dpools = self._draft_fn(
+            self.draft_pool.pools, self.draft_params, dtables, fill, prev, last,
+            self._next_rng(), temps, topks, topps,
+            model=self.draft_model, k=k,
+        )
+        self.draft_pool.swap(dpools)
+        journal.emit("draft", t0, label=f"b{bb}", active=len(batch),
+                     bucket=bb, blocks=nb, k=k)
+        t1 = journal.now()
+        packed, tpools = self._verify_fn(
+            self.pool.pools, self.params, tables, fill, last, proposals, dlogits,
+            self._next_rng(), temps, topks, topps, eos,
+            model=self.model, k=k,
+        )
+        self.pool.swap(tpools)
+        # ONE fetch: tokens and the n_new/n_accept counters ride together
+        out = np.asarray(packed)
+        now = time.perf_counter()
+        journal.emit("verify", t1, label=f"b{bb}", active=len(batch),
+                     bucket=bb, blocks=nb, k=k)
+        self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        for i, s in enumerate(batch):
+            n_new = int(out[i, k + 1])
+            self.ledger.spec_round(s.req.id, drafted=k, accepted=int(out[i, k + 2]))
+            for tok in out[i, :n_new]:
+                prev_last = s.last_token
+                s.fill += 1  # this token's K/V was written by the round
+                self._emit(s, int(tok), now)
+                if s.finished is not None:
+                    break
+                s.prev_token = prev_last
+
     def _emit(self, seq, tok: int, now: float) -> None:
         seq.out.append(tok)
         self.ledger.token(seq.req.id)
-        if tok == self.eos_id or len(seq.out) >= seq.req.max_new_tokens:
+        if tok == seq.eos_id or len(seq.out) >= seq.req.max_new_tokens:
             self.scheduler.finish(seq, now)
             self.ledger.finished(seq.req.id, now)
             self._done[seq.req.id] = seq

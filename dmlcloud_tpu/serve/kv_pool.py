@@ -110,6 +110,20 @@ class KVBlockPool:
                         for x in leaves.values())
         return per_layer * len(self.pools)
 
+    def stats(self) -> dict:
+        """The pool's accounting snapshot (``free + live == capacity`` by
+        construction): the utilization observable the serving scorecard
+        and bench receipts record — a speculative engine pays for TWO of
+        these (target + draft pages), and this is the number that says
+        what the draft pool actually costs."""
+        return {
+            "capacity": self.num_blocks,
+            "free": self.num_free,
+            "live": self.num_live,
+            "block_size": self.block_size,
+            "bytes_total": self.bytes_per_block() * self.num_blocks,
+        }
+
     # -- alloc / free --------------------------------------------------------
     def alloc(self, n: int) -> list[int]:
         """Hand out ``n`` free blocks; raises :class:`PoolExhausted` (and

@@ -9,11 +9,18 @@ duration). ``summary()`` reduces them to the numbers a capacity planner
 asks for: p50/p99 TTFT, mean queue wait, served tokens/s over the busy
 window, and the queue-depth profile the engine samples once per step.
 
+Speculative serving adds the accept-rate observables: per request, the
+tokens the draft proposed (``drafted``) and the tokens the verifier
+accepted (``accepted``) — counters that arrive packed in the same device
+fetch as the round's tokens (no extra readback; lint DML210), reduced in
+``summary()`` to total and per-request-mean accept rates.
+
 The ledger is pure host bookkeeping — O(1) dict/list appends per event,
 no device interaction — and rides next to the span journal: every record
-here corresponds to ``queue_wait`` / ``prefill`` / ``decode_batch`` spans
-when telemetry is armed, so a Perfetto timeline and this summary never
-disagree about what the engine did.
+here corresponds to ``queue_wait`` / ``prefill`` / ``decode_batch`` (and
+``draft`` / ``verify`` in spec mode) spans when telemetry is armed, so a
+Perfetto timeline and this summary never disagree about what the engine
+did.
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ class ServeLedger:
 
     # -- per-request events --------------------------------------------------
     def arrived(self, rid: int, now: float) -> None:
-        self.records[rid] = {"arrival": now, "tokens": 0}
+        self.records[rid] = {"arrival": now, "tokens": 0, "drafted": 0, "accepted": 0}
 
     def admitted(self, rid: int, now: float) -> None:
         self.records[rid]["admitted"] = now
@@ -51,6 +58,21 @@ class ServeLedger:
 
     def finished(self, rid: int, now: float) -> None:
         self.records[rid]["finished"] = now
+
+    def spec_round(self, rid: int, drafted: int, accepted: int) -> None:
+        """One speculative verification round's counters for a request.
+        The counts arrive packed in the SAME device fetch as the round's
+        tokens (serve/engine.py) — this is pure host accounting, never an
+        extra readback (lint DML210)."""
+        rec = self.records[rid]
+        rec["drafted"] += int(drafted)
+        rec["accepted"] += int(accepted)
+
+    def accept_rate(self, rid: int) -> float | None:
+        """The request's measured draft accept rate
+        (``accepted / drafted``); None before any verification round."""
+        rec = self.records[rid]
+        return rec["accepted"] / rec["drafted"] if rec["drafted"] else None
 
     # -- per-step samples ----------------------------------------------------
     def step_sample(self, queue_depth: int, batch_size: int) -> None:
@@ -79,6 +101,13 @@ class ServeLedger:
             t0 = min(r["arrival"] for r in self.records.values())
             t1 = max(r["finished"] for r in done)
             span = max(t1 - t0, 1e-9)
+        drafted = sum(r.get("drafted", 0) for r in self.records.values())
+        accepted = sum(r.get("accepted", 0) for r in self.records.values())
+        rates = [
+            r["accepted"] / r["drafted"]
+            for r in self.records.values()
+            if r.get("drafted", 0)
+        ]
         return {
             "requests": len(self.records),
             "completed": len(done),
@@ -90,4 +119,11 @@ class ServeLedger:
             "max_queue_depth": max(self.queue_depths, default=0),
             "mean_batch_size": float(np.mean(self.batch_sizes)) if self.batch_sizes else None,
             "decode_steps": self.decode_steps,
+            # speculative-decode counters (zero / None on a plain engine):
+            # totals across requests plus the per-request mean — the
+            # scorecard's accept-rate observable
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "accept_rate": round(accepted / drafted, 4) if drafted else None,
+            "mean_request_accept_rate": round(float(np.mean(rates)), 4) if rates else None,
         }

@@ -4,13 +4,16 @@ The scheduling contract, in order of importance:
 
 1. **No starvation.** Admission is STRICT FIFO with full reservation: the
    head of the waiting queue is admitted the moment a decode slot opens
-   AND the pool can cover its worst case (``ceil((prompt + max_new) /
-   block_size)`` blocks); nobody behind it may jump the queue even if they
-   would fit. Head-of-line blocking costs a little utilisation, but it
-   makes progress provable — every admitted request holds all the blocks
-   it can ever need (it cannot deadlock mid-decode), every finished
-   request frees a slot and blocks, so the head always eventually admits.
-   Property-tested over randomized traces in tests/test_serve.py.
+   AND the pool can cover its worst case (``ceil((prompt + max_new +
+   lookahead) / block_size)`` blocks, where ``lookahead`` is the engine's
+   speculative overshoot — ``k`` proposals a verification round may write
+   past the committed fill, 0 for plain decode); nobody behind it may
+   jump the queue even if they would fit. Head-of-line blocking costs a
+   little utilisation, but it makes progress provable — every admitted
+   request holds all the blocks it can ever need (it cannot deadlock
+   mid-decode), every finished request frees a slot and blocks, so the
+   head always eventually admits. Property-tested over randomized traces
+   (including spec-decode partial accepts) in tests/test_serve.py.
 2. **No drain barrier.** A sequence that emits EOS (or hits its token
    budget) releases its slot and blocks immediately; the next waiting
    request joins the running batch at the next step. Dense static
@@ -21,6 +24,12 @@ The scheduling contract, in order of importance:
    engine step, interleaved with the decode batch of the already-running
    streams — a 100k-token prompt delays running streams by one chunk's
    latency per step, never by its whole prefill.
+
+Speculative serving adds a second pool: the draft model's pages. The
+scheduler allocates from BOTH pools atomically at admission (a request
+holds its worst case in each, checked before either allocation so a
+failed admit leaks nothing) and frees both at finish — the ``free + live
+== capacity`` invariant holds per pool, always.
 
 The scheduler is pure host-side bookkeeping (deques of :class:`_Sequence`
 records); the engine owns every device interaction.
@@ -43,11 +52,19 @@ __all__ = ["Request", "Scheduler"]
 class Request:
     """One generation request. ``prompt`` is a 1-D int32 token array;
     ``adapter`` names a tenant adapter in the engine's ``AdapterSet``
-    (None = base model)."""
+    (None = base model). The sampling knobs (``temperature``/``top_k``/
+    ``top_p``/``eos_id``) are PER REQUEST — they ride the decode step as
+    traced per-row arrays, so one compiled engine serves mixed
+    greedy/sampled tenants in a single batch; None inherits the engine's
+    default."""
 
     prompt: Any
     max_new_tokens: int = 32
     adapter: str | None = None
+    temperature: float | None = None
+    top_k: int | None = None
+    top_p: float | None = None
+    eos_id: int | None = None
     id: int = -1  # assigned by the engine at submit
 
 
@@ -58,13 +75,20 @@ class _Sequence:
     req: Request
     arrival: float
     blocks: list[int] = field(default_factory=list)
+    draft_blocks: list[int] = field(default_factory=list)  # spec mode only
     fill: int = 0  # cache slots written (prefill progress, then decode)
     out: list[int] = field(default_factory=list)  # emitted tokens
     last_token: int = 0  # next decode step's input
+    prev_token: int = 0  # the token before it (spec rounds feed two)
     admitted: float | None = None
     first_token: float | None = None
     finished: float | None = None
     adapter_id: int = 0
+    # resolved per-row sampling params (request value or engine default)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int = -1
 
     @property
     def prompt_len(self) -> int:
@@ -74,22 +98,40 @@ class _Sequence:
     def prefilled(self) -> bool:
         return self.fill >= self.prompt_len
 
-    def needed_blocks(self, block_size: int) -> int:
-        """Blocks covering the next step's reads AND write (position
-        ``fill``), i.e. the live prefix only — what the decode batch
-        actually gathers, not the full reservation."""
-        return -(-(self.fill + 1) // block_size)
+    def needed_blocks(self, block_size: int, lookahead: int = 0) -> int:
+        """Blocks covering the next step's reads AND writes: position
+        ``fill`` for plain decode, through ``fill + lookahead`` when a
+        speculative round writes ``lookahead`` proposals past the pending
+        token — the live prefix plus this round's worst case, which is
+        what the decode batch actually gathers (the full reservation is
+        admission's concern)."""
+        return -(-(self.fill + 1 + int(lookahead)) // block_size)
 
 
 class Scheduler:
-    """FIFO continuous-batching admission over a :class:`KVBlockPool`."""
+    """FIFO continuous-batching admission over one :class:`KVBlockPool`
+    (plus the draft model's pool in speculative mode). ``lookahead`` is
+    the per-round speculative overshoot reserved per request (``spec_k``
+    for a spec engine, 0 otherwise)."""
 
-    def __init__(self, pool: KVBlockPool, max_slots: int, prefill_chunk: int):
+    def __init__(
+        self,
+        pool: KVBlockPool,
+        max_slots: int,
+        prefill_chunk: int,
+        *,
+        draft_pool: KVBlockPool | None = None,
+        lookahead: int = 0,
+    ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if lookahead < 0:
+            raise ValueError(f"lookahead must be >= 0, got {lookahead}")
         self.pool = pool
+        self.draft_pool = draft_pool
+        self.lookahead = int(lookahead)
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.waiting: collections.deque[_Sequence] = collections.deque()
@@ -111,32 +153,48 @@ class Scheduler:
         return len(self.waiting)
 
     # -- lifecycle -----------------------------------------------------------
+    def reservation(self, seq: _Sequence) -> int:
+        """The full worst-case block reservation of one request: every
+        slot its committed tokens can occupy PLUS the ``lookahead``
+        speculative positions the final round may write past them."""
+        return self.pool.blocks_for(
+            seq.prompt_len + seq.req.max_new_tokens + self.lookahead
+        )
+
     def submit(self, seq: _Sequence) -> None:
         """Queue a request. Rejects one that could NEVER be admitted —
         a worst case larger than the whole pool would starve the queue
         behind it forever under strict FIFO."""
-        need = self.pool.blocks_for(seq.prompt_len + seq.req.max_new_tokens)
-        if need > self.pool.num_blocks:
-            raise ValueError(
-                f"request needs {need} blocks worst-case but the pool only has "
-                f"{self.pool.num_blocks}; raise num_blocks or lower max_new_tokens"
-            )
+        need = self.reservation(seq)
+        pools = [self.pool] + ([self.draft_pool] if self.draft_pool else [])
+        for pool in pools:
+            if need > pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} blocks worst-case but the pool only has "
+                    f"{pool.num_blocks}; raise num_blocks or lower max_new_tokens"
+                )
         if seq.req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.waiting.append(seq)
 
     def admit(self, now: float) -> list[_Sequence]:
         """Admit from the head of the waiting queue while a slot AND the
-        head's full reservation fit. Returns the newly admitted sequences
-        (blocks already allocated, prefill pending)."""
+        head's full reservation fit — in EVERY pool, checked before
+        either allocation so a partial admit can never leak blocks.
+        Returns the newly admitted sequences (blocks already allocated,
+        prefill pending)."""
         admitted = []
         while self.waiting and self.active < self.max_slots:
             head = self.waiting[0]
-            need = self.pool.blocks_for(head.prompt_len + head.req.max_new_tokens)
+            need = self.reservation(head)
             if need > self.pool.num_free:
                 break  # strict FIFO: nobody may overtake the head
+            if self.draft_pool is not None and need > self.draft_pool.num_free:
+                break
             self.waiting.popleft()
             head.blocks = self.pool.alloc(need)
+            if self.draft_pool is not None:
+                head.draft_blocks = self.draft_pool.alloc(need)
             head.admitted = now
             self.prefilling.append(head)
             admitted.append(head)
@@ -153,13 +211,17 @@ class Scheduler:
 
     def finish(self, seq: _Sequence, now: float) -> None:
         """Release a finished sequence's slot and blocks IMMEDIATELY —
-        the no-drain-barrier property lives here."""
+        the no-drain-barrier property lives here (both pools in spec
+        mode: the draft pages recycle with the target's)."""
         if seq in self.running:
             self.running.remove(seq)
         elif seq in self.prefilling:
             self.prefilling.remove(seq)
         self.pool.free(seq.blocks)
         seq.blocks = []
+        if self.draft_pool is not None and seq.draft_blocks:
+            self.draft_pool.free(seq.draft_blocks)
+        seq.draft_blocks = []
         seq.finished = now
 
     def decode_batch(self) -> list[_Sequence]:

@@ -73,6 +73,8 @@ SPAN_KINDS = frozenset(
         "queue_wait",  # serving: request arrival -> admission (serve/)
         "prefill",  # serving: one chunked-prefill device call
         "decode_batch",  # serving: one continuous-batching decode step
+        "draft",  # serving: draft-model device call (spec proposals/prefill)
+        "verify",  # serving: one k+1-position spec verification pass
     }
 )
 

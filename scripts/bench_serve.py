@@ -1,21 +1,27 @@
 #!/usr/bin/env python
 """Serving A/B receipt: the continuous-batching engine (dmlcloud_tpu/serve/)
 vs serial ``generate()`` calls on the pinned CPU-smoke Poisson request
-trace (doc/serving.md):
+trace, plus the SPECULATIVE arm — the ``spec_k`` engine vs the plain
+engine on a pinned Markov trace with a trained target/draft pair
+(doc/serving.md):
 
-- tokens/s over the busy window for both arms (the engine batches up to
-  ``max_slots`` decode streams; serial services one request at a time)
+- tokens/s over the busy window for every arm (the engine batches up to
+  ``max_slots`` decode streams; serial services one request at a time;
+  the spec engine commits up to k+1 tokens per verify round)
 - p50/p99 time-to-first-token under the same arrival process (serial TTFT
   is honest: one compiled program emits nothing until it returns)
-- greedy token-identity of the engine against serial generate, and the
-  engine's compiled-signature count against its TraceGuard budget
+- greedy token-identity of both engines against serial generate, the
+  measured draft accept rate, compiled-signature counts against the
+  TraceGuard budgets, and the spec arm's mid-run recompile count (must
+  be 0)
 
 Thin CLI over ``bench.bench_serve`` (which runs ``bench.py --serve-child``
 CPU-pinned) so the committed receipt and an interactive investigation run
 the exact same workload. The receipt's flat ``gate`` section is what
-``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares.
+``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares
+(``serve_*`` and ``serve_spec_*`` keys; missing metric = FAIL).
 
-    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_pr08.json
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_spec_pr10.json
 """
 
 import argparse

@@ -1,0 +1,42 @@
+"""DML210 bad fixture: serve/decode loops that read their accept/round
+counters back to host EVERY iteration — one extra device sync per round
+on top of the loop's sanctioned token fetch (the r05 0.19x regression).
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+import numpy as np
+
+
+def spec_serve_loop(spec_step, engine, requests):
+    accepted_total = 0
+    while requests:
+        tokens, n_accept, pools = spec_step(requests)
+        accepted_total += int(n_accept)  # BAD: per-round counter readback
+        engine.emit(np.asarray(tokens))  # the token fetch itself is sanctioned
+    return accepted_total
+
+
+def per_round_item(step, state, steps):
+    for _ in range(steps):
+        state = step(state)
+        rate = state["accept_counts"].item()  # BAD: .item() every round
+        state["rate"] = rate
+    return state
+
+
+def asarray_counters(verify, batches):
+    out = []
+    for batch in batches:
+        toks, accept_counts = verify(batch)
+        out.append(np.asarray(accept_counts))  # BAD: counters fetched alone
+    return out
+
+
+def aliased_counter(round_fn, state, live):
+    total = 0.0
+    while live:
+        state, live = round_fn(state)
+        acc = state["accepted"]
+        total += float(acc)  # BAD: flow-aware — acc binds to state["accepted"]
+    return total

@@ -1,0 +1,32 @@
+"""DML210 clean fixture: the sanctioned counter patterns — counters ride
+the loop's ONE packed token fetch, or are read once after the loop.
+
+Static lint corpus — never imported or executed. Expected findings: 0.
+"""
+
+import numpy as np
+
+
+def packed_fetch_loop(spec_step, engine, requests):
+    while requests:
+        packed, pools = spec_step(requests)
+        # the ONE host sync per round: tokens AND counters ride together
+        out = np.asarray(packed)
+        for row in out:
+            n_new = int(row[-2])  # host ints of an already-fetched array
+            engine.commit(row[:n_new], int(row[-1]))
+    return engine
+
+
+def counters_read_after_loop(step, state, steps):
+    for _ in range(steps):
+        state = step(state)  # accept counts stay in the device carry
+    # once per trace, not once per round: fine
+    return int(state["accepted"]), float(state["rounds"])
+
+
+def token_fetch_only(decode_step, engine, batches):
+    for batch in batches:
+        tokens, pools = decode_step(batch)
+        engine.emit(np.asarray(tokens))  # tokens ARE the output
+    return engine

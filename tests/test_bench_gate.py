@@ -277,6 +277,105 @@ def test_committed_serve_receipt_satisfies_the_gate():
     assert eng["compiled_signatures"] <= eng["max_signatures"]
 
 
+# ------------------------------------------------ serve suite: spec decode
+
+SERVE_SPEC_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "serve_tokens_per_sec_speedup": 3.0,
+        "serve_engine_tokens_per_sec": 300.0,
+        "serve_p99_ttft_s": 1.5,
+        "serve_spec_speedup_vs_engine": 1.6,
+        "serve_spec_accept_rate": 0.9,
+        "serve_spec_tokens_per_sec": 480.0,
+        "serve_spec_p99_ttft_s": 1.8,
+        "serve_spec_token_identical": 1,
+        "serve_spec_zero_recompiles": 1,
+    },
+}
+
+
+def test_serve_spec_accept_rate_regression_fails(tmp_path, capsys):
+    """A collapsing accept rate (the r01-r05 0.0 failure mode) is a
+    regression like any other ratio: dropping past tolerance FAILS."""
+    doctored = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+    doctored["gate"]["serve_spec_accept_rate"] = 0.2
+    doctored["gate"]["serve_spec_speedup_vs_engine"] = 1.5
+    base = _write(tmp_path, "BENCH_serve_spec_base.json", SERVE_SPEC_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "serve_spec_accept_rate" in capsys.readouterr().out
+
+
+def test_serve_spec_speedup_regression_fails(tmp_path, capsys):
+    """Speculation that stops composing with the engine (speedup back to
+    ~1x) FAILS against the committed receipt."""
+    doctored = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+    doctored["gate"]["serve_spec_speedup_vs_engine"] = 1.0
+    doctored["gate"]["serve_spec_tokens_per_sec"] = 300.0
+    base = _write(tmp_path, "BENCH_serve_spec_base.json", SERVE_SPEC_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "serve_spec_speedup_vs_engine" in capsys.readouterr().out
+
+
+def test_serve_spec_identity_and_recompiles_are_pass_fail(tmp_path, capsys):
+    """Token identity and the zero-mid-run-recompile contract ride the
+    gate as 1/0 ints: flipping either to 0 is a 100% drop — FAIL."""
+    for key in ("serve_spec_token_identical", "serve_spec_zero_recompiles"):
+        doctored = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+        doctored["gate"][key] = 0
+        base = _write(tmp_path, f"BENCH_serve_{key}.json", SERVE_SPEC_RECEIPT)
+        assert run_gate(base, current=doctored) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_serve_spec_missing_metric_fails(tmp_path, capsys):
+    """A spec metric that silently vanishes from the current run (e.g. the
+    spec arm stopped running at all) is a FAIL, not a pass."""
+    current = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+    del current["gate"]["serve_spec_accept_rate"]
+    base = _write(tmp_path, "BENCH_serve_spec_base.json", SERVE_SPEC_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_serve_spec_p99_ttft_is_lower_is_better(tmp_path):
+    fast = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+    fast["gate"]["serve_spec_p99_ttft_s"] = 0.2  # improvement: passes
+    base = _write(tmp_path, "BENCH_serve_spec_base.json", SERVE_SPEC_RECEIPT)
+    assert run_gate(base, current=fast) == 0
+    slow = json.loads(json.dumps(SERVE_SPEC_RECEIPT))
+    slow["gate"]["serve_spec_p99_ttft_s"] = 1.8 * 2.5  # > 2x: regression
+    assert run_gate(base, current=slow) == 1
+
+
+def test_committed_serve_spec_receipt_satisfies_the_gate():
+    """The committed PR 10 receipt must pass its own gate and meet the
+    acceptance floors: spec engine >= 1.4x the non-spec engine's tokens/s
+    at accept rate >= 0.8, greedy output token-identical to serial
+    generate, zero mid-run recompiles inside the TraceGuard budget."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_spec_pr10.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    assert gate["serve_spec_speedup_vs_engine"] >= 1.4
+    assert gate["serve_spec_accept_rate"] >= 0.8
+    assert gate["serve_spec_token_identical"] == 1
+    assert gate["serve_spec_zero_recompiles"] == 1
+    spec = receipt["spec"]
+    assert spec["token_identical_to_serial"] is True
+    assert spec["mid_run_recompiles"] == 0
+    eng = spec["spec_engine"]
+    assert eng["compiled_signatures"] <= eng["max_signatures"]
+    assert eng["completed"] == spec["config"]["n_requests"]
+    assert eng["accept_rate"] >= 0.8
+    # the old serve keys must still be present — one receipt carries both
+    for key in ("serve_tokens_per_sec_speedup", "serve_p99_ttft_s"):
+        assert key in gate
+
+
 def test_committed_elastic_receipt_satisfies_the_gate():
     """The committed PR 7 receipt must pass its own gate and certify exact
     resumption: 0 steps replayed, a resumable preemption verdict."""

@@ -386,3 +386,72 @@ def test_long_generation_exercises_multi_step_segments():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     beam_toks, _ = beam_search(model, params, prompt, max_new_tokens=n, num_beams=1)
     np.testing.assert_array_equal(np.asarray(beam_toks), np.asarray(got))
+
+
+class TestBatchedSampler:
+    """sample_logits_batched: the per-row traced twin of sample_logits
+    (the serving engine's mixed-tenant sampling path)."""
+
+    def _logits(self, b=4, v=61, seed=6, scale=3.0):
+        return jax.random.normal(jax.random.PRNGKey(seed), (b, v)) * scale
+
+    @pytest.mark.parametrize(
+        "t,k,p",
+        [(0.0, 0, 1.0), (0.7, 0, 1.0), (1.0, 10, 1.0), (0.9, 0, 0.7), (1.2, 5, 0.9)],
+    )
+    def test_uniform_rows_match_scalar_sampler(self, t, k, p):
+        """A batch whose rows all share one param set must sample the SAME
+        tokens as the scalar sampler with those params (same rng, same
+        truncation, same categorical)."""
+        from dmlcloud_tpu.models.generate import sample_logits, sample_logits_batched
+
+        logits = self._logits()
+        rng = jax.random.PRNGKey(5)
+        a = sample_logits(logits, rng, t, k, p)
+        b = sample_logits_batched(
+            logits, rng,
+            jnp.full(4, t, jnp.float32), jnp.full(4, k, jnp.int32), jnp.full(4, p, jnp.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mixed_rows_greedy_is_exact_argmax(self):
+        """Rows with temperature 0 in a mixed batch return the exact
+        argmax regardless of the other rows' params."""
+        from dmlcloud_tpu.models.generate import sample_logits_batched
+
+        logits = self._logits()
+        out = sample_logits_batched(
+            logits, jax.random.PRNGKey(0),
+            jnp.asarray([0.0, 1.5, 0.0, 0.8]),
+            jnp.asarray([0, 5, 0, 0], jnp.int32),
+            jnp.asarray([1.0, 1.0, 1.0, 0.6]),
+        )
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        assert int(out[0]) == greedy[0] and int(out[2]) == greedy[2]
+
+    def test_top_k_truncation_is_per_row(self):
+        """top_k=1 rows must return the argmax (only one candidate
+        survives) even at high temperature; top_k=0 rows stay untruncated."""
+        from dmlcloud_tpu.models.generate import sample_logits_batched
+
+        logits = self._logits(b=3)
+        out = sample_logits_batched(
+            logits, jax.random.PRNGKey(1),
+            jnp.asarray([5.0, 5.0, 5.0]),
+            jnp.asarray([1, 1, 0], jnp.int32),
+            jnp.ones(3, jnp.float32),
+        )
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        assert int(out[0]) == greedy[0] and int(out[1]) == greedy[1]
+
+    def test_top_p_tiny_nucleus_is_argmax(self):
+        """top_p small enough keeps only the head of the distribution —
+        with a dominant logit the sample is forced to the argmax."""
+        from dmlcloud_tpu.models.generate import sample_logits_batched
+
+        logits = jnp.zeros((2, 8)).at[:, 3].set(10.0)
+        out = sample_logits_batched(
+            logits, jax.random.PRNGKey(2),
+            jnp.asarray([1.0, 1.0]), jnp.zeros(2, jnp.int32), jnp.asarray([0.1, 0.1]),
+        )
+        np.testing.assert_array_equal(np.asarray(out), [3, 3])

@@ -45,6 +45,7 @@ BAD_EXPECT = {
     "DML207": 3,
     "DML208": 4,
     "DML209": 5,
+    "DML210": 4,
     "DML301": 2,
     "DML302": 2,
 }

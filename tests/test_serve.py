@@ -168,6 +168,34 @@ class TestPagedIndexing:
         out = scatter_tokens(pool, tables, jnp.asarray([[4]], jnp.int32), vals)
         np.testing.assert_array_equal(np.asarray(out), 0.0)  # dropped, block 1 intact
 
+    def test_negative_position_dropped(self):
+        """A negative position (a padded row of a spec round's 2-token
+        draft pass) maps below the table and must be dropped, never
+        wrapped into a real block."""
+        pool = jnp.zeros((3, 2, 1, 1), jnp.float32)
+        tables = jnp.asarray([[0, 1]], jnp.int32)
+        vals = jnp.full((1, 2, 1, 1), 5.0)
+        out = scatter_tokens(pool, tables, jnp.asarray([[-1, 0]], jnp.int32), vals)
+        assert float(out[0, 0, 0, 0]) == 5.0  # position 0 landed
+        assert float(np.asarray(out).sum()) == 5.0  # position -1 dropped
+
+    def test_multi_token_scatter_through_tables(self):
+        """The spec round's k+1-token write: several positions per row in
+        ONE scatter land in the right (block, slot) pairs, across block
+        boundaries."""
+        pool = jnp.zeros((4, 2, 1, 1), jnp.float32)
+        tables = jnp.asarray([[2, 0]], jnp.int32)  # logical 0-1 -> block 2, 2-3 -> block 0
+        positions = jnp.asarray([[1, 2, 3]], jnp.int32)  # straddles the boundary
+        vals = jnp.asarray([10.0, 20.0, 30.0]).reshape(1, 3, 1, 1)
+        out = scatter_tokens(pool, tables, positions, vals)
+        assert float(out[2, 1, 0, 0]) == 10.0  # position 1: block 2, slot 1
+        assert float(out[0, 0, 0, 0]) == 20.0  # position 2: block 0, slot 0
+        assert float(out[0, 1, 0, 0]) == 30.0  # position 3: block 0, slot 1
+        got = gather_pages(out, tables)
+        np.testing.assert_array_equal(
+            np.asarray(got[0, 1:4, 0, 0]), [10.0, 20.0, 30.0]
+        )
+
 
 # ---------------------------------------------------------------------------
 # engine vs serial generate: token identity
@@ -312,6 +340,264 @@ class TestBucketing:
             engine.run(max_steps=5000)
             if assert_warm:
                 assert engine.compiled_signatures() == before
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding inside the engine (draft/verify over paged KV)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_draft():
+    """An INDEPENDENT random-init draft (different arch): near-zero accept
+    rate, so every round exercises the partial-accept rewind."""
+    cfg = _tiny_cfg(num_layers=1, num_heads=2, num_kv_heads=1, hidden_dim=16, mlp_dim=32)
+    model = DecoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(9), jnp.ones((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+class TestSpeculativeEngine:
+    def test_self_draft_identity_and_exact_full_accept(self, tiny_model):
+        """Shared-model self-draft (the smoke config): greedy output
+        token-identical to serial generate, accept rate EXACTLY 1.0, both
+        pools drained clean."""
+        model, params = tiny_model
+        specs = [(7, 6), (13, 4), (5, 9), (22, 5)]
+        engine = _engine(model, params, spec_k=3)
+        rids = [engine.submit(_prompt(n, seed=i), m) for i, (n, m) in enumerate(specs)]
+        out = engine.run(max_steps=5000)
+        for rid, (n, m) in zip(rids, specs):
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(n, seed=rid))[None], m)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+        s = engine.ledger.summary()
+        assert s["accept_rate"] == 1.0
+        assert s["drafted_tokens"] > 0
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
+
+    def test_partial_accepts_stay_token_identical(self, tiny_model, tiny_draft):
+        """An independent random draft disagrees with the target almost
+        everywhere — near-zero accept — yet greedy output must STILL be
+        token-identical to serial generate: rejected proposals leave stale
+        K/V that the rewind contract (fill counters roll back, contiguous
+        rewrites beat the causal mask) must fully hide."""
+        model, params = tiny_model
+        draft, dparams = tiny_draft
+        specs = [(7, 6), (13, 4), (5, 9), (22, 5), (3, 8)]
+        engine = _engine(
+            model, params, max_slots=3, spec_k=4, draft_model=draft, draft_params=dparams
+        )
+        rids = [engine.submit(_prompt(n, seed=i), m) for i, (n, m) in enumerate(specs)]
+        out = engine.run(max_steps=5000)
+        for rid, (n, m) in zip(rids, specs):
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(n, seed=rid))[None], m)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+        assert engine.ledger.summary()["accept_rate"] < 0.5  # genuinely partial
+
+    def test_spec_random_load_invariants(self, tiny_model, tiny_draft):
+        """The satellite property test: random spec-decode load with
+        partial accepts — after EVERY engine step both pools hold
+        free + live == capacity, admissions stay strict FIFO, every
+        request finishes (starvation-free), and the drained pools are
+        pristine."""
+        model, params = tiny_model
+        draft, dparams = tiny_draft
+        rs = np.random.RandomState(13)
+        engine = ServeEngine(
+            model, params, num_blocks=28, block_size=4, max_slots=3, prefill_chunk=8,
+            spec_k=3, draft_model=draft, draft_params=dparams,
+        )
+        specs = [(int(rs.randint(1, 18)), int(rs.randint(1, 8))) for _ in range(24)]
+        rids = [
+            engine.submit(_prompt(n, seed=300 + i), m) for i, (n, m) in enumerate(specs)
+        ]
+        steps = 0
+        while not engine.idle and steps < 5000:
+            engine.step()
+            steps += 1
+            for pool in (engine.pool, engine.draft_pool):
+                assert pool.num_free + pool.num_live == pool.num_blocks
+        out = engine.results()
+        assert sorted(out) == sorted(rids), "an admitted request starved"
+        for rid, (_, m) in zip(rids, specs):
+            assert len(out[rid]) == m
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
+        admits = [engine.ledger.records[r]["admitted"] for r in rids]
+        assert admits == sorted(admits)  # strict FIFO held
+
+    def test_spec_signature_budget_and_warm_replay(self, tiny_model):
+        """Churning spec traffic stays inside the enlarged (draft +
+        verify + two-model prefill) TraceGuard budget, and a warm engine
+        replaying the same shapes compiles NOTHING new."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=4, spec_k=3, guard="raise")
+        specs = [(5 + 3 * (i % 4), 3 + (i % 3)) for i in range(8)]
+        for wave, assert_warm in ((0, False), (1, True)):
+            before = engine.compiled_signatures()
+            for i, (n, m) in enumerate(specs):
+                engine.submit(_prompt(n, seed=100 * wave + i), m)
+            engine.run(max_steps=5000)
+            if assert_warm:
+                assert engine.compiled_signatures() == before
+        assert engine.compiled_signatures() <= engine.max_signatures
+
+    def test_spec_eos_truncates_inside_a_round(self, tiny_model):
+        """A row whose eos lands mid-round must stop at the eos token
+        exactly (device-side in-round truncation + host finish)."""
+        model, params = tiny_model
+        prompt = _prompt(9, seed=3)
+        ref = np.asarray(generate(model, params, jnp.asarray(prompt)[None], 8))[0]
+        eos = int(ref[2])
+        assert eos not in ref[:2]
+        engine = _engine(model, params, spec_k=3, eos_id=eos)
+        rid = engine.submit(prompt, 8)
+        out = engine.run(max_steps=2000)[rid]
+        np.testing.assert_array_equal(out, ref[:3])
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
+
+    def test_reservation_accounts_spec_lookahead(self, tiny_model):
+        """Admission reserves prompt + max_new + k worst case; the
+        max_seq_len check carries the k+1 speculative slack; and
+        needed_blocks covers this round's k-token overshoot."""
+        from dmlcloud_tpu.serve.scheduler import _Sequence
+
+        model, params = tiny_model
+        engine = _engine(model, params, spec_k=3)  # block_size 4
+        rid = engine.submit(_prompt(4), 4)
+        seq = engine.scheduler.waiting[0]
+        assert engine.scheduler.reservation(seq) == -(-(4 + 4 + 3) // 4)  # 11 slots
+        # plain engine reserves less for the same request
+        plain = _engine(model, params)
+        plain.submit(_prompt(4), 4)
+        assert plain.scheduler.reservation(plain.scheduler.waiting[0]) == 2
+        # max_seq_len check is spec-aware: 31 + 30 fits plain (61 <= 64)
+        # but not with the +k+1 speculative slack (65 > 64)
+        with pytest.raises(ValueError, match="spec_k"):
+            engine.submit(_prompt(31), 30)
+        # needed_blocks: lookahead widens the table the round gathers
+        s = _Sequence(req=seq.req, arrival=0.0)
+        s.fill = 7
+        assert s.needed_blocks(4) == 2  # plain: slots 0..7
+        assert s.needed_blocks(4, lookahead=3) == 3  # spec: writes to 10
+
+    def test_spec_rejects_adapters_and_bad_args(self, tiny_model):
+        model, params = tiny_model
+        from dmlcloud_tpu.models.lora import lora_init
+
+        tree = lora_init(jax.random.PRNGKey(1), params, rank=2, in_axes=1)
+        aset = AdapterSet({"a": tree}, base=params)
+        with pytest.raises(ValueError, match="adapters"):
+            _engine(model, params, spec_k=2, adapters=aset)
+        with pytest.raises(ValueError, match="together"):
+            _engine(model, params, spec_k=2, draft_model=model)
+        with pytest.raises(ValueError, match="spec_k"):
+            _engine(model, params, draft_model=model, draft_params=params)
+
+    def test_ledger_accept_counters_are_exact(self, tiny_model):
+        """Self-draft greedy accepts everything: drafted == rounds * k,
+        accepted == drafted, per-request accept_rate == 1.0 — the exact
+        on-device counters, fetched once per round with the tokens."""
+        model, params = tiny_model
+        engine = _engine(model, params, spec_k=3)
+        rid = engine.submit(_prompt(6, seed=2), 9)
+        engine.run(max_steps=2000)
+        rec = engine.ledger.records[rid]
+        assert rec["drafted"] > 0 and rec["drafted"] % 3 == 0
+        assert rec["accepted"] == rec["drafted"]
+        assert engine.ledger.accept_rate(rid) == 1.0
+        s = engine.ledger.summary()
+        assert s["mean_request_accept_rate"] == 1.0
+        assert s["accepted_tokens"] == s["drafted_tokens"]
+
+    def test_spec_journal_spans(self, tiny_model, tmp_path):
+        from dmlcloud_tpu.telemetry import journal as journal_mod
+
+        model, params = tiny_model
+        j = journal_mod.SpanJournal(tmp_path, rank=0)
+        journal_mod.activate(j)
+        try:
+            engine = _engine(model, params, spec_k=2)
+            engine.submit(_prompt(12, seed=1), 5)
+            engine.run(max_steps=2000)
+        finally:
+            journal_mod.deactivate()
+        spans = j.tail(512)
+        kinds = {rec["kind"] for rec in spans}
+        assert {"queue_wait", "prefill", "draft", "verify"} <= kinds
+        assert "decode_batch" not in kinds  # spec rounds replace plain decode
+        # every verify round pairs with a draft call; prefill drafts are extra
+        n_verify = sum(1 for r in spans if r["kind"] == "verify")
+        n_draft = sum(1 for r in spans if r["kind"] == "draft")
+        assert n_verify >= 1 and n_draft >= n_verify
+
+
+# ---------------------------------------------------------------------------
+# per-request sampling params
+# ---------------------------------------------------------------------------
+
+
+class TestPerRequestSampling:
+    def test_mixed_batch_greedy_rows_bit_identical(self, tiny_model):
+        """Greedy and sampled tenants share one batch; the greedy rows
+        must decode exactly what serial generate decodes — the
+        batched-sampler lock."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=4)
+        r_g = engine.submit(_prompt(8, seed=1), 6)
+        r_s1 = engine.submit(_prompt(8, seed=2), 6, temperature=0.9, top_k=12)
+        r_s2 = engine.submit(_prompt(8, seed=3), 6, temperature=1.3, top_p=0.8)
+        out = engine.run()
+        ref = np.asarray(generate(model, params, jnp.asarray(_prompt(8, seed=1))[None], 6))[0]
+        np.testing.assert_array_equal(out[r_g], ref)
+        for r in (r_s1, r_s2):
+            assert out[r].shape == (6,)
+            assert ((out[r] >= 0) & (out[r] < model.cfg.vocab_size)).all()
+
+    def test_per_request_eos(self, tiny_model):
+        """Two requests with the same prompt, different eos: each stops at
+        its OWN eos — eos is per-row data, not engine state."""
+        model, params = tiny_model
+        prompt = _prompt(9, seed=3)
+        ref = np.asarray(generate(model, params, jnp.asarray(prompt)[None], 8))[0]
+        eos = int(ref[2])
+        engine = _engine(model, params)
+        ra = engine.submit(prompt, 8, eos_id=eos)
+        rb = engine.submit(prompt, 8)
+        out = engine.run()
+        np.testing.assert_array_equal(out[ra], ref[:3])
+        np.testing.assert_array_equal(out[rb], ref)
+
+    def test_request_params_ride_the_request(self, tiny_model):
+        """Request carries the overrides; unset knobs inherit the engine
+        defaults."""
+        model, params = tiny_model
+        engine = _engine(model, params, temperature=0.5, top_k=7)
+        rid = engine.submit(_prompt(4), 2, temperature=0.0)
+        seq = engine.scheduler.waiting[0]
+        assert seq.req.id == rid
+        assert seq.temperature == 0.0  # override
+        assert seq.top_k == 7  # engine default inherited
+        assert seq.eos_id == -1
+
+    def test_spec_mixed_sampling_batch(self, tiny_model):
+        """Per-row params flow through the spec verify step too: a greedy
+        and a sampled row share a spec batch; the greedy row stays
+        identical to serial generate."""
+        model, params = tiny_model
+        engine = _engine(model, params, spec_k=3)
+        r_g = engine.submit(_prompt(8, seed=1), 6)
+        r_s = engine.submit(_prompt(8, seed=2), 6, temperature=1.1)
+        out = engine.run(max_steps=2000)
+        ref = np.asarray(generate(model, params, jnp.asarray(_prompt(8, seed=1))[None], 6))[0]
+        np.testing.assert_array_equal(out[r_g], ref)
+        assert ((out[r_s] >= 0) & (out[r_s] < model.cfg.vocab_size)).all()
 
 
 # ---------------------------------------------------------------------------

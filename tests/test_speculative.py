@@ -342,3 +342,105 @@ def test_rewound_cache_bit_identical_at_accepted_prefix(models):
                 a[row, : common[row]], b[row, : common[row]],
                 err_msg="accepted-prefix cache slots differ between drafts",
             )
+
+
+class TestVerifyProposals:
+    """verify_proposals: the batched per-row-params accept rule the
+    serving engine's spec verify step runs (same math as the in-loop
+    greedy/rejection rules above, B rows at once)."""
+
+    def _inputs(self, b=3, k=4, v=17, seed=0):
+        rng = jax.random.PRNGKey(seed)
+        tlogits = jax.random.normal(jax.random.fold_in(rng, 1), (b, k + 1, v)) * 2.0
+        dlogits = jax.random.normal(jax.random.fold_in(rng, 2), (b, k, v)) * 2.0
+        proposals = jax.random.randint(jax.random.fold_in(rng, 3), (b, k), 0, v)
+        return tlogits, dlogits, proposals.astype(jnp.int32)
+
+    def test_greedy_rows_match_numpy_reference(self):
+        from dmlcloud_tpu.models.speculative import verify_proposals
+
+        b, k = 3, 4
+        tlogits, dlogits, proposals = self._inputs(b, k)
+        zeros = jnp.zeros(b)
+        new_tokens, n_new, n_accept = verify_proposals(
+            tlogits, dlogits, proposals, jax.random.PRNGKey(7),
+            zeros, jnp.zeros(b, jnp.int32), jnp.ones(b), jnp.full(b, -1, jnp.int32),
+        )
+        tl = np.asarray(tlogits)
+        props = np.asarray(proposals)
+        for r in range(b):
+            greedy = tl[r].argmax(-1)  # [k+1]
+            acc = 0
+            while acc < k and props[r, acc] == greedy[acc]:
+                acc += 1
+            assert int(n_accept[r]) == acc
+            assert int(n_new[r]) == acc + 1
+            # committed tokens are the target's greedy tokens through the
+            # correction — exactly what serial greedy decode would emit
+            np.testing.assert_array_equal(
+                np.asarray(new_tokens)[r, : acc + 1], greedy[: acc + 1]
+            )
+
+    def test_eos_truncates_the_advance(self):
+        from dmlcloud_tpu.models.speculative import verify_proposals
+
+        b, k, v = 2, 3, 11
+        # force full greedy acceptance: proposals == target argmax
+        tlogits = jax.random.normal(jax.random.PRNGKey(3), (b, k + 1, v)) * 2.0
+        proposals = jnp.argmax(tlogits[:, :k], axis=-1).astype(jnp.int32)
+        dlogits = jnp.zeros((b, k, v))
+        eos0 = int(proposals[0, 1])  # row 0's second committed token
+        new_tokens, n_new, n_accept = verify_proposals(
+            tlogits, dlogits, proposals, jax.random.PRNGKey(8),
+            jnp.zeros(b), jnp.zeros(b, jnp.int32), jnp.ones(b),
+            jnp.asarray([eos0, -1], jnp.int32),
+        )
+        assert int(n_accept[0]) == k  # acceptance is eos-blind
+        assert int(n_new[0]) == 2  # ...but the advance stops AT the eos
+        assert int(np.asarray(new_tokens)[0, 1]) == eos0
+        assert int(n_new[1]) == k + 1  # the other row is untouched
+
+    def test_sampled_rows_accept_everything_when_draft_is_target(self):
+        """When dlogits IS the truncated target distribution, the
+        rejection test accepts with probability min(1, 1) = 1 — every
+        proposal must be accepted (the engine's shared-model smoke)."""
+        from dmlcloud_tpu.models.generate import _truncate_scaled
+        from dmlcloud_tpu.models.speculative import verify_proposals
+
+        b, k = 3, 4
+        tlogits, _, _ = self._inputs(b, k)
+        temp = jnp.full(b, 0.8)
+        topk = jnp.zeros(b, jnp.int32)
+        topp = jnp.ones(b)
+        truncated = _truncate_scaled(tlogits[:, :k].astype(jnp.float32), temp, topk, topp)
+        # proposals sampled from the draft's own rows (any supported token)
+        proposals = jnp.argmax(truncated, axis=-1).astype(jnp.int32)
+        _, n_new, n_accept = verify_proposals(
+            tlogits, truncated, proposals, jax.random.PRNGKey(9),
+            temp, topk, topp, jnp.full(b, -1, jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(n_accept), [k] * b)
+        np.testing.assert_array_equal(np.asarray(n_new), [k + 1] * b)
+
+    def test_mixed_greedy_and_sampled_rows_in_one_call(self):
+        """Row 0 greedy, row 1 sampled: the greedy row's commitment is the
+        argmax rule's regardless of the sampled row's dice."""
+        from dmlcloud_tpu.models.speculative import verify_proposals
+
+        b, k = 2, 3
+        tlogits, dlogits, proposals = self._inputs(b, k, seed=4)
+        new_tokens, n_new, n_accept = verify_proposals(
+            tlogits, dlogits, proposals, jax.random.PRNGKey(11),
+            jnp.asarray([0.0, 1.0]), jnp.zeros(b, jnp.int32), jnp.ones(b),
+            jnp.full(b, -1, jnp.int32),
+        )
+        greedy = np.asarray(tlogits)[0].argmax(-1)
+        acc = 0
+        while acc < k and int(proposals[0, acc]) == greedy[acc]:
+            acc += 1
+        assert int(n_accept[0]) == acc
+        np.testing.assert_array_equal(
+            np.asarray(new_tokens)[0, : acc + 1], greedy[: acc + 1]
+        )
+        assert 0 <= int(n_accept[1]) <= k
+        assert 1 <= int(n_new[1]) <= k + 1

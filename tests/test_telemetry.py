@@ -45,6 +45,8 @@ V1_KINDS = {
     "watchdog", "sanitizer",
     # serving engine (PR 8): queue wait, chunked prefill, decode batches
     "queue_wait", "prefill", "decode_batch",
+    # speculative serving (PR 10): draft-model calls, verification passes
+    "draft", "verify",
 }
 
 #: Core fields every v1 record carries, with their types.
