@@ -1218,12 +1218,142 @@ def _spec_serve_section():
     }
 
 
+#: the CPU-smoke PREFIX-CACHE serving A/B config — pinned so receipts stay
+#: comparable. The realistic multi-tenant shape: 80% of requests share one
+#: of a handful of templates (a long system prompt / few-shot preamble)
+#: with a short unique suffix; 20% are fully unique. Long prompts + short
+#: generations make the trace PREFILL-dominated — the regime prefix
+#: sharing exists for — and the small prefill chunk makes the uncached
+#: cost visible (7+ chunks cold vs 1 warm). Arrivals are paced (not
+#: saturating) so TTFT measures prefill latency, not queueing.
+_SERVE_PREFIX_CFG = dict(
+    n_requests=30, n_templates=4, template_len=112, suffix_lens=(4, 8),
+    new_tokens=8, mean_interarrival_s=0.05, seed=0,
+    block_size=16, num_blocks=96, max_slots=4, prefill_chunk=16,
+)
+
+
+def _serve_prefix_trace():
+    """The pinned 80%-shared-template Poisson trace: request ``i`` is
+    template-shaped unless ``i % 5 == 4`` (exactly 80%), cycling through
+    the templates; suffixes and the 20% unique prompts are fresh draws."""
+    c = _SERVE_PREFIX_CFG
+    sc = _SERVE_CFG  # model geometry (vocab, max_seq_len) is the serve model's
+    rs = np.random.RandomState(c["seed"])
+    templates = [
+        rs.randint(0, sc["vocab"], size=c["template_len"]).astype(np.int32)
+        for _ in range(c["n_templates"])
+    ]
+    offsets = np.cumsum(rs.exponential(c["mean_interarrival_s"], c["n_requests"]))
+    trace, shared = [], []
+    for i in range(c["n_requests"]):
+        if i % 5 != 4:
+            tmpl = templates[i % c["n_templates"]]
+            suffix = rs.randint(
+                0, sc["vocab"], size=c["suffix_lens"][i % len(c["suffix_lens"])]
+            ).astype(np.int32)
+            prompt = np.concatenate([tmpl, suffix])
+            shared.append(True)
+        else:
+            prompt = rs.randint(
+                0, sc["vocab"], size=c["template_len"] + c["suffix_lens"][0]
+            ).astype(np.int32)
+            shared.append(False)
+        trace.append((float(offsets[i]), prompt, c["new_tokens"]))
+    return trace, shared
+
+
+def _serve_prefix_section():
+    """The prefix-cache A/B: the engine WITH radix-tree sharing
+    (``prefix_cache=True``) vs the SAME engine without it, on the pinned
+    80%-shared-template trace — the tentpole receipt of ISSUE 11. Returns
+    the results dict whose numbers feed the ``serve_prefix_*`` gate keys:
+    warm-template p50 TTFT (the headline — near-zero prefill for a warm
+    template), hit rate, the fraction of prefill tokens saved, greedy
+    token-identity to the uncached engine, and zero mid-run recompiles."""
+    from dmlcloud_tpu.serve import ServeEngine
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c = _SERVE_PREFIX_CFG
+    model, params = _serve_model()
+    trace, shared = _serve_prefix_trace()
+
+    def engine_kw():
+        return dict(
+            num_blocks=c["num_blocks"], block_size=c["block_size"],
+            max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"],
+        )
+
+    def run_arm(**extra):
+        eng = ServeEngine(model, params, **engine_kw(), **extra)
+        # warm pass: compiles every signature AND (in the cached arm)
+        # populates the radix tree — the measured replay is the warm
+        # steady state a long-running server lives in
+        eng.serve_trace([(0.0, p, n) for _, p, n in trace])
+        warm_outs = [eng.output(i) for i in range(len(trace))]
+        warm_sigs = eng.compiled_signatures()
+        eng.ledger = ServeLedger()
+        summary = eng.serve_trace(trace)
+        return eng, summary, warm_outs, warm_sigs
+
+    base_eng, base, base_outs, _ = run_arm()
+    pref_eng, pref, pref_outs, pref_warm_sigs = run_arm(prefix_cache=True)
+    recompiles = pref_eng.compiled_signatures() - pref_warm_sigs
+
+    identical = all(
+        np.array_equal(a, b) for a, b in zip(pref_outs, base_outs)
+    )
+
+    def warm_p50(eng, offset):
+        ttfts = [
+            eng.ledger.records[offset + i]["first_token"]
+            - eng.ledger.records[offset + i]["arrival"]
+            for i in range(len(trace))
+            if shared[i]
+        ]
+        return round(float(np.percentile(ttfts, 50)), 4)
+
+    # the measured replay's requests are ids n..2n-1 (the warm pass took 0..n-1)
+    n = len(trace)
+    warm_cached = warm_p50(pref_eng, n)
+    warm_uncached = warm_p50(base_eng, n)
+    s = pref_eng.ledger.summary()
+    rnd = lambda d: {
+        k: (round(v, 4) if isinstance(v, float) else v) for k, v in d.items()
+    }
+    return {
+        "config": dict(c),
+        "engine": rnd(base),
+        "prefix_engine": {
+            **rnd(pref),
+            "compiled_signatures": pref_eng.compiled_signatures(),
+            "max_signatures": pref_eng.max_signatures,
+            "pool": pref_eng.pool.stats(),
+            "cache": pref_eng.prefix.stats(),
+        },
+        # template-shaped requests' p50 TTFT, measured in each arm on the
+        # SAME request subset — the headline near-zero-prefill number
+        "warm_template_p50_ttft_s": warm_cached,
+        "uncached_template_p50_ttft_s": warm_uncached,
+        "warm_ttft_ratio": (
+            round(warm_cached / warm_uncached, 4) if warm_uncached else None
+        ),
+        "hit_rate": s["prefix_hit_rate"],
+        "cached_token_frac": s["cached_token_frac"],
+        "prefill_tokens_saved_frac": s["prefill_tokens_saved_frac"],
+        "token_identical_to_uncached": bool(identical),
+        "mid_run_recompiles": int(recompiles),
+    }
+
+
 def serve_child_main():
     """A/B the continuous-batching engine against serial ``generate()`` on
     the pinned Poisson trace, then the speculative engine against the
-    plain engine on the pinned Markov trace (CPU-pinned child); prints one
-    marker line of JSON — the source of ``BENCH_serve_*.json`` and of
-    ``bench.py --gate --suite serve``'s current numbers."""
+    plain engine on the pinned Markov trace, then the prefix-cache engine
+    against the uncached engine on the pinned 80%-shared-template trace
+    (CPU-pinned child); prints one marker line of JSON — the source of
+    ``BENCH_serve_*.json`` and of ``bench.py --gate --suite serve``'s
+    current numbers."""
     jax.config.update("jax_platforms", "cpu")
     from dmlcloud_tpu.serve import ServeEngine
     from dmlcloud_tpu.serve.ledger import ServeLedger
@@ -1255,6 +1385,7 @@ def serve_child_main():
         else None
     )
     spec = _spec_serve_section()
+    prefix = _serve_prefix_section()
     results = {
         "config": dict(c),
         "value_source": "cpu_smoke",
@@ -1267,6 +1398,7 @@ def serve_child_main():
         "speedup_tokens_per_sec": speedup,
         "token_identical_to_serial": identical,
         "spec": spec,
+        "prefix": prefix,
         # the flat, schema-stable section the perf gate compares
         "gate": {
             "serve_tokens_per_sec_speedup": speedup,
@@ -1281,6 +1413,15 @@ def serve_child_main():
             "serve_spec_p99_ttft_s": spec["spec_engine"]["p99_ttft_s"],
             "serve_spec_token_identical": int(bool(spec["token_identical_to_serial"])),
             "serve_spec_zero_recompiles": int(spec["mid_run_recompiles"] == 0),
+            # prefix-cache sharing (ISSUE 11): warm-template TTFT as a
+            # lower-is-better latency, hit rate + prefill-skip fraction as
+            # ratios, token-identity-to-uncached and the
+            # zero-mid-run-recompile contract as pass/fail ints
+            "serve_prefix_warm_ttft_s": prefix["warm_template_p50_ttft_s"],
+            "serve_prefix_hit_rate": prefix["hit_rate"],
+            "serve_prefix_prefill_tokens_saved_frac": prefix["prefill_tokens_saved_frac"],
+            "serve_prefix_token_identical": int(bool(prefix["token_identical_to_uncached"])),
+            "serve_prefix_zero_recompiles": int(prefix["mid_run_recompiles"] == 0),
         },
     }
     print(_SERVE_MARKER + json.dumps(results), flush=True)
@@ -1546,6 +1687,7 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "elastic_time_to_resume_s",
         "serve_p99_ttft_s",
         "serve_spec_p99_ttft_s",
+        "serve_prefix_warm_ttft_s",
         "data_wait_s",
     }
 )
@@ -1595,9 +1737,17 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
     BASELINE carries must be present in the current run (a silently missing
     number is a failure, not a pass — that is exactly how the r05 all-null
     receipt slipped through) and must not drop more than ``tolerance``
-    relative. Metrics only the current run carries are informational."""
-    with open(baseline_path) as f:
-        baseline = json.load(f)
+    relative. Metrics only the current run carries are informational.
+
+    ``baseline_path`` may also be an already-merged metrics dict (the
+    serve suite folds EVERY committed receipt into one baseline, each key
+    at its most recently committed value)."""
+    if isinstance(baseline_path, dict):
+        baseline, baseline_name = baseline_path, "merged receipts"
+    else:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        baseline_name = os.path.basename(baseline_path)
     if isinstance(current, str):
         with open(current) as f:
             current = json.load(f)
@@ -1609,11 +1759,11 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
             return 2
     base_m, cur_m = _gate_metrics(baseline), _gate_metrics(current)
     if not base_m:
-        print(f"gate: FAIL — no gate metrics in baseline {baseline_path}", file=sys.stderr)
+        print(f"gate: FAIL — no gate metrics in baseline {baseline_name}", file=sys.stderr)
         return 2
     failures = []
     width = max(len(k) for k in base_m)
-    print(f"perf gate vs {os.path.basename(baseline_path)} (tolerance {tolerance:.0%}):")
+    print(f"perf gate vs {baseline_name} (tolerance {tolerance:.0%}):")
     for k, bv in sorted(base_m.items()):
         cv = cur_m.get(k)
         if cv is None:
@@ -1650,9 +1800,12 @@ def gate_main(argv: list) -> int:
     drill and compares its metrics against the last committed
     ``BENCH_elastic_*.json`` (exact resume, save-on-preempt latency,
     time-to-resume); the ``serve`` suite replays the Poisson serving A/B
-    against the last committed ``BENCH_serve_*.json`` (tokens/s speedup vs
-    serial generate, absolute engine tokens/s, p99 TTFT as a
-    lower-is-better latency); the ``data`` suite replays the streaming
+    against EVERY committed ``BENCH_serve_*.json`` merged into one
+    baseline — each key at its most recently committed value (tokens/s
+    speedup vs serial generate, p99 TTFT, the ``serve_spec_*`` composition
+    keys and the ``serve_prefix_*`` sharing keys — warm-template TTFT
+    judged lower-is-better; every receipt's keys stay enforced, so a
+    silently-vanished metric FAILS); the ``data`` suite replays the streaming
     packed-vs-pad-to-max A/B against the last committed
     ``BENCH_data_*.json`` (packed tokens/s speedup, padding waste
     reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better
@@ -1695,11 +1848,29 @@ def gate_main(argv: list) -> int:
                 return 2
         rcs.append(run_gate(baseline, current, tolerance))
     if suite in ("serve", "all"):
-        baseline = _opt("--baseline") if suite == "serve" else None
-        baseline = baseline or _latest_receipt("serve")
-        if baseline is None:
-            print("gate: FAIL — no --baseline and no committed BENCH_serve_*.json", file=sys.stderr)
-            return 2
+        explicit = _opt("--baseline") if suite == "serve" else None
+        if explicit is not None:
+            baseline = explicit
+        else:
+            # EVERY committed serve receipt folds into ONE merged baseline,
+            # each key at its most recently committed value (receipts
+            # sorted by name; later receipts override earlier per key).
+            # That is what makes a silently-vanished serve_prefix_* metric
+            # a FAIL — the pr11 receipt's keys stay enforced — without an
+            # older receipt's stale absolute numbers (e.g. pr08's tokens/s
+            # from a different box era) resurrecting as floors.
+            import glob as _glob
+
+            here = os.path.dirname(os.path.abspath(__file__))
+            receipts = sorted(_glob.glob(os.path.join(here, "BENCH_serve_*.json")))
+            if not receipts:
+                print("gate: FAIL — no --baseline and no committed BENCH_serve_*.json", file=sys.stderr)
+                return 2
+            merged: dict = {}
+            for path in receipts:
+                with open(path) as f:
+                    merged.update(_gate_metrics(json.load(f)))
+            baseline = {"gate": merged}
         current = _opt("--current") if suite == "serve" else None
         if current is None:
             print("gate: running the serving A/B (serve suite child)...", file=sys.stderr)

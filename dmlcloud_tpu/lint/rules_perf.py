@@ -21,6 +21,13 @@ contracts that keep them fast checkable on CPU:
           made the r05 speculative path 0.19×; counters must stay on
           device or ride the loop's one token fetch (packed columns,
           serve/engine.py's pattern)
+- DML211  a paged-scatter call (or a block-table-entry write) with NO
+          preceding copy-on-write fork / refcount check, in code that
+          handles SHARED blocks (prefix sharing, serve/prefix_cache.py):
+          a block with refcount > 1 is mapped read-only into other
+          requests' tables — writing through it silently corrupts every
+          other reader's cached prefix, a cross-request correctness bug
+          no test on the writing request can see
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -56,6 +63,7 @@ __all__ = [
     "check_scan_remat",
     "check_cache_alloc_in_loop",
     "check_counter_readback_in_loop",
+    "check_unguarded_shared_block_write",
 ]
 
 
@@ -410,6 +418,147 @@ def check_counter_readback_in_loop(ctx: ModuleCtx):
             )
 
     yield from visit(ctx.tree, False)
+
+
+# ------------------------------------------------------------------- DML211
+
+#: identifiers that mark a module as HANDLING SHARED BLOCKS — prefix-cache
+#: machinery (the radix tree, refcounts, copy-on-write). Only such modules
+#: are in scope: traced kernel code (ops/, models/) cannot see host-side
+#: refcounts and legitimately scatters unconditionally.
+_SHARING_VOCAB = re.compile(
+    r"(?i)(prefix_?cache|radix|shared_blocks?|refcount|(^|_)cow(_|$)|copy_on_write)"
+)
+
+#: a call whose terminal name matches this counts as the COW fork /
+#: refcount check that must precede a shared-block write
+_COW_GUARD = re.compile(r"(?i)(cow|refcount|is_shared|writable|fork)")
+
+#: block-table receivers: a subscript STORE into one of these is a
+#: table-entry write (remapping which physical page a row reads/writes)
+_TABLEISH = re.compile(r"(?i)(block_)?tables?$")
+
+
+def _module_handles_shared_blocks(ctx: ModuleCtx) -> bool:
+    """Whether the module's IDENTIFIERS (names, attributes, imports,
+    parameters, keywords — never docstrings or comments) mention the
+    prefix-sharing machinery."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and _SHARING_VOCAB.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _SHARING_VOCAB.search(node.attr):
+            return True
+        if isinstance(node, ast.keyword) and node.arg and _SHARING_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, ast.arg) and _SHARING_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            if isinstance(node, ast.ImportFrom) and node.module:
+                names.append(node.module)
+            if any(_SHARING_VOCAB.search(n) for n in names):
+                return True
+    return False
+
+
+def _is_scatter_call(ctx: ModuleCtx, node: ast.Call) -> bool:
+    """``scatter_tokens(...)`` — chased through import aliases
+    (``paged.scatter_tokens``) and local assignment aliases (``scat =
+    scatter_tokens; scat(...)``) via the dataflow core."""
+    func = node.func
+    resolved = ctx.resolve(func) or ""
+    last = resolved.split(".")[-1] if resolved else ""
+    if not last and isinstance(func, ast.Attribute):
+        last = func.attr
+    if not last and isinstance(func, ast.Name):
+        last = func.id
+    if last == "scatter_tokens":
+        return True
+    if isinstance(func, ast.Name):
+        bound = dataflow.resolve_expr(func, ctx.scopes_at(node))
+        if bound is not None and bound is not func:
+            chained = (ctx.resolve(bound) or "").split(".")[-1]
+            if not chained and isinstance(bound, ast.Name):
+                chained = bound.id
+            if chained == "scatter_tokens":
+                return True
+    return False
+
+
+def _is_cow_guard_call(node: ast.Call) -> bool:
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else ""
+    )
+    return bool(_COW_GUARD.search(name))
+
+
+def _table_store_name(stmt: ast.AST) -> str | None:
+    """The table-ish receiver of a subscript STORE (``tables[i] = b``,
+    ``row.block_tables[i, j] = b``), else None."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if not isinstance(t, ast.Subscript):
+            continue
+        base = t.value
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if name and _TABLEISH.search(name):
+            return name
+    return None
+
+
+@rule("DML211", "paged scatter / block-table write without a preceding COW fork or refcount check")
+def check_unguarded_shared_block_write(ctx: ModuleCtx):
+    """In code that handles SHARED blocks (the prefix-cache machinery:
+    refcounted pools, radix matches, copy-on-write forks), a
+    ``scatter_tokens(...)`` call or a block-table-entry write
+    (``tables[i] = block``) that no COW fork / refcount check precedes in
+    the same function writes through pages other requests may be reading
+    — corrupting THEIR cached prefixes, a cross-request bug the writing
+    request's own output never shows. The guard must come FIRST (a fork
+    swaps the table entry, so tables built before the guard are stale):
+    any call naming the contract (``_cow_guard``/``fork``/``refcount``/
+    ``is_shared``/``ensure_writable``) earlier in the function body
+    sanctions every later write in that function. Flow-aware:
+    ``scatter_tokens`` is chased through import and assignment aliases;
+    traced kernel modules (no sharing vocabulary) are out of scope — they
+    cannot see host refcounts, their callers carry the contract."""
+    if not _module_handles_shared_blocks(ctx):
+        return
+
+    hazards: list[tuple[ast.AST, str, ast.AST | None]] = []
+    guards: dict[ast.AST | None, int] = {}  # enclosing fn -> first guard line
+    for node in ast.walk(ctx.tree):
+        fn = ctx.enclosing_function(node)
+        if isinstance(node, ast.Call):
+            if _is_cow_guard_call(node):
+                guards[fn] = min(guards.get(fn, node.lineno), node.lineno)
+            elif _is_scatter_call(ctx, node):
+                hazards.append((node, "scatter_tokens(...) paged write", fn))
+        else:
+            name = _table_store_name(node)
+            if name is not None:
+                hazards.append((node, f"write to block table entry '{name}[...]'", fn))
+
+    for node, what, fn in hazards:
+        first_guard = guards.get(fn)
+        if first_guard is not None and first_guard < node.lineno:
+            continue  # fork/refcount check precedes: the contract is held
+        yield _f(
+            ctx, "DML211", node,
+            f"{what} with no preceding COW fork / refcount check in "
+            "shared-block code: a refcount>1 block is mapped read-only into "
+            "other requests' tables — fork it first (ServeEngine._cow_guard: "
+            "copy the page, swap the table entry, release the shared "
+            "original), then build the tables the scatter uses",
+            getattr(fn, "name", ""),
+        )
 
 
 @rule("DML206", "scan over a layer stack without a remat policy")

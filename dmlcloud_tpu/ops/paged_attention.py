@@ -27,6 +27,17 @@ pass writes 2 then 1 positions, the verification pass scatters all
 call (and gathers once for the whole round) — the multi-token round cost
 that replaces plain decode's per-token cost (serve/engine.py).
 
+Prefix sharing (``serve/prefix_cache.py``) adds one asymmetric contract:
+the SAME physical block may appear in many rows' tables (and in many
+concurrent batches) — :func:`gather_pages` needs nothing special for
+that, every row just reads the shared page. :func:`scatter_tokens` is the
+dangerous half: a write through a table entry whose block has
+``refcount > 1`` would corrupt every other reader's prefix, so the
+serving engine copy-on-write forks (or refcount-checks) BEFORE building
+the tables it scatters through — refcounts are host state, invisible to
+this traced code, which is exactly why the ordering is enforced
+statically by lint rule DML211 rather than here.
+
 Out-of-range handling is the whole trick for static shapes: block tables
 are padded with a SENTINEL entry equal to ``num_blocks`` (one past the
 pool). jax clips out-of-bounds *gather* indices — the sentinel reads the

@@ -15,6 +15,10 @@ static batch per call; this package turns it into a serving engine:
   (mixed greedy/sampled tenants in one batch) and speculative decoding
   (``spec_k`` draft proposals per round against a second page pool, one
   k+1-position verify pass, partial-accept rewind by fill counters).
+- :class:`PrefixCache` (prefix_cache.py): radix-tree prefix sharing over
+  content-addressed, refcounted pool blocks — a warm template's prefill
+  shrinks to its unique suffix; copy-on-write forks protect shared pages;
+  eviction is leaf-first LRU over refcount (``prefix_cache=True``).
 - :class:`AdapterSet` (adapters.py): multi-tenant LoRA serving, one base
   model + per-request adapter deltas inside the decode step.
 - :class:`ServeLedger` (ledger.py): TTFT / per-token / queue-depth
@@ -39,12 +43,15 @@ from .adapters import AdapterSet
 from .engine import ServeEngine
 from .kv_pool import KVBlockPool, PoolExhausted
 from .ledger import ServeLedger
+from .prefix_cache import PrefixCache, PrefixMatch
 from .scheduler import Request, Scheduler
 
 __all__ = [
     "AdapterSet",
     "KVBlockPool",
     "PoolExhausted",
+    "PrefixCache",
+    "PrefixMatch",
     "Request",
     "Scheduler",
     "ServeEngine",

@@ -33,6 +33,19 @@ continuous batching, no drain barrier.
   the per-token cost of the weight-bandwidth-bound decode loop becomes a
   per-round cost.
 
+**Prefix sharing** (``prefix_cache=True``, serve/prefix_cache.py): pool
+blocks become content-addressed and refcounted, indexed by a radix tree
+over token prefixes. Admission maps a prompt's longest cached full-block
+prefix READ-ONLY into the new request's table and starts chunked prefill
+at the divergence point — a warm template's prefill shrinks to its unique
+suffix (near-zero TTFT). Every write path runs a copy-on-write guard
+first (``_cow_guard``: fork any refcount>1 block the scatter would touch
+— one traced ``_copy_block`` signature for every fork ever; lint DML211
+enforces the ordering), and the pool evicts leaf-first by LRU over
+refcount when the free list runs dry. Greedy output stays token-identical
+to the uncached engine — the committed ``BENCH_serve_prefix_*.json``
+receipt re-asserts it on an 80%-shared-template trace.
+
 **Per-request sampling.** ``temperature``/``top_k``/``top_p``/``eos_id``
 ride each :class:`Request` and enter the compiled steps as per-row traced
 arrays (``models.generate.sample_logits_batched``), so one engine serves
@@ -81,9 +94,20 @@ from ..telemetry import journal
 from .adapters import AdapterSet
 from .kv_pool import KVBlockPool
 from .ledger import ServeLedger
+from .prefix_cache import PrefixCache
 from .scheduler import Request, Scheduler, _Sequence
 
 __all__ = ["ServeEngine"]
+
+
+def _copy_block(pools, src, dst):
+    """The copy-on-write fork's device half: copy page ``src`` to page
+    ``dst`` across every layer's K/V leaves. ``src``/``dst`` are TRACED
+    scalars, so every fork in the engine's lifetime replays ONE compiled
+    signature (a Python-int ``.at[i].set`` would bake the ids in and
+    compile per (src, dst) pair — a mid-run recompile per fork).
+    ``pools`` is donated: the fork is a swap, never two live pools."""
+    return jax.tree_util.tree_map(lambda x: x.at[dst].set(x[src]), pools)
 
 
 def _paged_step(
@@ -148,7 +172,7 @@ def _spec_draft_step(
 
 def _spec_verify_step(
     pools, params, tables, fill, last_tok, proposals, dlogits, rng,
-    temperature, top_k, top_p, eos_id, *, model, k,
+    temperature, top_k, top_p, eos_id, adapters, *, model, k,
 ):
     """The verify half: ONE target pass scores all ``k+1`` positions per
     row (``[y_last, d_1..d_k]`` written at ``fill..fill+k`` through the
@@ -156,13 +180,19 @@ def _spec_verify_step(
     each row's own accept rule. Returns ``(packed [B, k+3], pools)`` —
     the ``k+1`` tokens to commit plus the ``n_new``/``n_accept`` counters
     as two extra columns, so ONE host fetch carries tokens AND counters
-    (no separate counter readback per round — DML210). ``pools`` is
+    (no separate counter readback per round — DML210). ``adapters``
+    threads per-row LoRA deltas into the TARGET pass only (spec × LoRA:
+    the base-model draft proposes without the tenant's delta — it only
+    costs accept rate; the verifier scores with the adapter, so output
+    stays token-identical to the tenant's own model). ``pools`` is
     donated."""
     from ..models.generate import decode_step
     from ..models.speculative import verify_proposals
 
     x = jnp.concatenate([last_tok[:, None], proposals], axis=1)  # [B, k+1]
-    tlogits, pools = decode_step(model, params, x, pools, pages=(tables, fill))
+    tlogits, pools = decode_step(
+        model, params, x, pools, pages=(tables, fill), adapters=adapters
+    )
     new_tokens, n_new, n_accept = verify_proposals(
         tlogits, dlogits, proposals, rng, temperature, top_k, top_p, eos_id
     )
@@ -205,9 +235,18 @@ class ServeEngine:
       accept rate exactly 1.0 under greedy); ``draft_num_blocks`` sizes
       the draft page pool (default: the target pool's count).
     - ``adapters``: an :class:`AdapterSet` for multi-tenant LoRA serving;
-      requests pick a tenant by name (plain mode only for now — the
-      draft would propose without the tenant's delta, collapsing the
-      accept rate).
+      requests pick a tenant by name. Composes with ``spec_k``: the
+      base-model draft proposes WITHOUT the tenant's delta (costing only
+      accept rate on heavily-adapted tenants) while the verify pass
+      scores with it, so output stays token-identical to the tenant's
+      own model.
+    - ``prefix_cache``: arm radix-tree prefix sharing (False by default —
+      the exact PR-8/PR-10 engine). Blocks become content-addressed and
+      refcounted; a request whose prompt shares full cached blocks maps
+      them read-only, skips their prefill entirely (chunked prefill
+      starts at the divergence point) and copy-on-write forks before any
+      write into a shared page; the pool evicts leaf-first by LRU when
+      the free list runs dry. See serve/prefix_cache.py + doc/serving.md.
     - ``guard``: ``TraceGuard`` action on a signature leak ("raise"/"warn").
     """
 
@@ -231,6 +270,7 @@ class ServeEngine:
         draft_params: Any = None,
         draft_num_blocks: int | None = None,
         adapters: AdapterSet | None = None,
+        prefix_cache: bool = False,
         rng: jax.Array | None = None,
         guard: str = "raise",
         cache_dtype: Any = None,
@@ -243,11 +283,6 @@ class ServeEngine:
             raise ValueError("draft_model and draft_params must be passed together")
         if draft_model is not None and spec_k < 1:
             raise ValueError("a draft model needs spec_k >= 1")
-        if spec_k and adapters is not None:
-            raise ValueError(
-                "speculative decoding with per-request adapters is not supported: "
-                "the draft would propose without the tenant's delta"
-            )
         self.model = model
         cfg = model.cfg
         # one-time host-side preparation: int8 kernels stay fused-quantized
@@ -276,9 +311,14 @@ class ServeEngine:
                 block_size=block_size,
                 dtype=cache_dtype,
             )
+        # prefix sharing: the radix tree lives over the TARGET pool only —
+        # the draft pool has no tree (draft prefill skips via the target's
+        # match length; the verifier guarantees token identity regardless)
+        self.prefix = PrefixCache(self.pool) if prefix_cache else None
         self.scheduler = Scheduler(
             self.pool, max_slots, prefill_chunk,
             draft_pool=self.draft_pool, lookahead=self.spec_k,
+            prefix_cache=self.prefix,
         )
         self.ledger = ServeLedger()
         self.adapters = adapters
@@ -303,11 +343,13 @@ class ServeEngine:
         # so a fresh partial per engine gives each engine its own cache —
         # the TraceGuard budget is then this engine's alone, not the
         # process-wide total across every engine ever built
-        def _guarded(fn, budget, name, donate=(0,)):
+        def _guarded(fn, budget, name, donate=(0,), statics=None):
+            if statics is None:
+                statics = ("model",) + (("k",) if fn is not _paged_step else ())
             return TraceGuard(
                 jax.jit(
                     functools.partial(fn),
-                    static_argnames=("model",) + (("k",) if fn is not _paged_step else ()),
+                    static_argnames=statics,
                     donate_argnums=donate,
                 ),
                 max_traces=budget, action=guard, name=name,
@@ -331,6 +373,12 @@ class ServeEngine:
             self.max_signatures = self._step_budget
             self._draft_fn = self._verify_fn = None
         self._step_fn = _guarded(_paged_step, self._step_budget, "serve_paged_step")
+        self._copy_fn = None
+        if self.prefix is not None:
+            # COW fork: traced src/dst -> ONE signature for every fork the
+            # engine ever performs (counted in the budget)
+            self._copy_fn = _guarded(_copy_block, 1, "serve_cow_copy", statics=())
+            self.max_signatures += 1
 
     # -- request lifecycle ---------------------------------------------------
     def submit(
@@ -401,7 +449,7 @@ class ServeEngine:
         """Distinct compiled signatures so far, summed over the engine's
         jitted steps (the TraceGuard probes)."""
         total = 0
-        for fn in (self._step_fn, self._draft_fn, self._verify_fn):
+        for fn in (self._step_fn, self._draft_fn, self._verify_fn, self._copy_fn):
             if fn is None:
                 continue
             n = fn.cache_size()
@@ -418,6 +466,14 @@ class ServeEngine:
         now = time.perf_counter()
         for seq in self.scheduler.admit(now):
             self.ledger.admitted(seq.req.id, now)
+            if self.prefix is not None:
+                # prefill-skip accounting: saved = the divergence point the
+                # scheduler rolled prefill forward to (cached tokens, minus
+                # the one re-fed token of an exact full-block match)
+                self.ledger.prefix_match(
+                    seq.req.id, cached=seq.cached_tokens, saved=seq.fill,
+                    prompt=seq.prompt_len,
+                )
             journal.emit("queue_wait", seq.arrival, now, label=f"req{seq.req.id}",
                          request=seq.req.id, depth=self.scheduler.depth())
         did = False
@@ -488,10 +544,11 @@ class ServeEngine:
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(eos)
         )
 
-    def _call(self, pool, model, params, tables, fill, tokens, last_idx, ids, row_params):
+    def _call(self, pool, model, params, tables, fill, tokens, last_idx, ids, row_params,
+              use_adapters=True):
         temps, topks, topps, _ = row_params
         adapters = None
-        if self.adapters is not None:
+        if self.adapters is not None and use_adapters:
             adapters = (self.adapters.stacked, jnp.asarray(ids, jnp.int32))
         tok, new_pools = self._step_fn(
             pool.pools, params,
@@ -502,6 +559,40 @@ class ServeEngine:
         )
         pool.swap(new_pools)
         return np.asarray(tok)  # the per-step host sync: tokens ARE the output
+
+    def _cow_guard(self, seq, lo: int, hi: int) -> None:
+        """The copy-on-write fork rule: before ANY paged scatter that will
+        write positions ``[lo, hi)`` of ``seq``, fork every covered block
+        whose refcount > 1 — a shared page is read-only (other tables map
+        it; the radix tree pins it), so the write gets a private copy
+        first. The fork consumes the COW spare the scheduler reserved at
+        admission (an exact full-block match is the one flow that
+        guarantees a fork; see scheduler.admit), falls back to a fresh
+        alloc otherwise, device-copies the page through the ONE traced
+        ``_copy_block`` signature, swaps the table entry and releases this
+        sequence's reference to the shared original. No-op without a
+        prefix cache (nothing is ever shared) and on the common decode
+        path (writes land past the shared prefix by construction)."""
+        if self.prefix is None:
+            return
+        bs = self.pool.block_size
+        for bi in range(lo // bs, (max(hi, lo + 1) - 1) // bs + 1):
+            if bi >= len(seq.blocks) or not self.pool.is_shared(seq.blocks[bi]):
+                continue
+            old = seq.blocks[bi]
+            if seq.cow_spare > 0:
+                new = seq.blocks.pop()  # the spare reserved at admission
+                seq.cow_spare -= 1
+            else:
+                [new] = self.pool.alloc(1)
+            self.pool.swap(
+                self._copy_fn(self.pool.pools, jnp.int32(old), jnp.int32(new))
+            )
+            seq.blocks[bi] = new
+            self.pool.release([old])
+            seq.shared = min(seq.shared, bi)
+            journal.emit("prefill", journal.now(), label=f"req{seq.req.id}:cow",
+                         request=seq.req.id, cow_block=bi)
 
     def _table_rows(self, seqs, nb: int, draft: bool = False) -> np.ndarray:
         pool = self.draft_pool if draft else self.pool
@@ -515,6 +606,11 @@ class ServeEngine:
     def _prefill_chunk(self, seq) -> None:
         c = self.scheduler.prefill_chunk
         n = min(c, seq.prompt_len - seq.fill)
+        # COW-fork before the scatter: an exact full-block prefix match
+        # re-feeds the final prompt token, whose write lands in the last
+        # SHARED block (the one write the sharing design ever aims at a
+        # refcount>1 page)
+        self._cow_guard(seq, seq.fill, seq.fill + n)
         tokens = np.zeros((1, c), np.int32)
         tokens[0, :n] = seq.req.prompt[seq.fill : seq.fill + n]
         nb = bucket_for(self.pool.blocks_for(seq.fill + n), self.table_buckets)
@@ -538,6 +634,7 @@ class ServeEngine:
                 self.draft_pool, self.draft_model, self.draft_params,
                 self._table_rows([seq], nb, draft=True), fill, tokens, last,
                 [seq.adapter_id], row_params,
+                use_adapters=False,  # the draft proposes base-model (spec x LoRA)
             )
             journal.emit("draft", t1, label=f"req{seq.req.id}:prefill",
                          request=seq.req.id, chunk=n, blocks=nb)
@@ -549,9 +646,18 @@ class ServeEngine:
             self.ledger.first_token(seq.req.id, now)
             self.scheduler.prefill_done(seq)
             seq.prev_token = int(seq.req.prompt[-1])
+            if self.prefix is not None:
+                # the prompt's full blocks now hold correct K/V: publish
+                # them so the NEXT request with this prefix skips prefill
+                self.prefix.insert(seq.req.prompt, seq.blocks, adapter=seq.adapter_id)
             self._emit(seq, int(tok[0]), now)
 
     def _decode(self, batch) -> None:
+        for s in batch:
+            # refcount check before the scatter (DML211): decode writes at
+            # fill, past the shared prefix by construction — a fork here
+            # means an invariant broke upstream, but the guard is cheap
+            self._cow_guard(s, s.fill, s.fill + 1)
         bb = bucket_for(len(batch), self.batch_buckets)
         needed = max(s.needed_blocks(self.pool.block_size) for s in batch)
         nb = bucket_for(needed, self.table_buckets)
@@ -587,6 +693,10 @@ class ServeEngine:
         overwritten by the next round's contiguous writes before the
         causal mask can expose it, and block ownership never changes."""
         k = self.spec_k
+        for s in batch:
+            # a spec round writes fill..fill+k (verify) — COW/refcount
+            # check before the multi-token scatter (DML211)
+            self._cow_guard(s, s.fill, s.fill + k + 1)
         bb = bucket_for(len(batch), self.batch_buckets)
         needed = max(
             s.needed_blocks(self.pool.block_size, lookahead=k) for s in batch
@@ -606,6 +716,14 @@ class ServeEngine:
             prev[i] = s.prev_token
             last[i] = s.last_token
         temps, topks, topps, eos = self._row_params(batch, bb)
+        adapters = None
+        if self.adapters is not None:
+            # spec x LoRA: the VERIFY pass scores with each row's adapter
+            # (the draft proposed base-model — only accept rate pays)
+            ids = np.zeros(bb, np.int32)
+            for i, s in enumerate(batch):
+                ids[i] = s.adapter_id
+            adapters = (self.adapters.stacked, jnp.asarray(ids, jnp.int32))
         tables = jnp.asarray(tables, jnp.int32)
         dtables = jnp.asarray(dtables, jnp.int32)
         fill = jnp.asarray(fill, jnp.int32)
@@ -624,7 +742,7 @@ class ServeEngine:
         t1 = journal.now()
         packed, tpools = self._verify_fn(
             self.pool.pools, self.params, tables, fill, last, proposals, dlogits,
-            self._next_rng(), temps, topks, topps, eos,
+            self._next_rng(), temps, topks, topps, eos, adapters,
             model=self.model, k=k,
         )
         self.pool.swap(tpools)
@@ -649,6 +767,17 @@ class ServeEngine:
         seq.out.append(tok)
         self.ledger.token(seq.req.id)
         if tok == seq.eos_id or len(seq.out) >= seq.req.max_new_tokens:
+            if self.prefix is not None and seq.fill > seq.prompt_len:
+                # multi-turn sharing: publish the full blocks the decode
+                # extended (K/V written through position fill-1; a spec
+                # round's stale tail lives past fill, in blocks this
+                # slice never reaches). finish() then drops only this
+                # request's references — adopted pages stay cached.
+                written = np.concatenate(
+                    [np.asarray(seq.req.prompt, np.int32),
+                     np.asarray(seq.out, np.int32)]
+                )[: seq.fill]
+                self.prefix.insert(written, seq.blocks, adapter=seq.adapter_id)
             self.scheduler.finish(seq, now)
             self.ledger.finished(seq.req.id, now)
             self._done[seq.req.id] = seq

@@ -29,6 +29,17 @@ The pool object is deliberately split-brained:
   foreign-block frees raise immediately — the invariant ``free + live ==
   capacity`` is load-bearing for a server that must not leak a block per
   million requests (property-tested in tests/test_serve.py).
+
+Blocks are REFERENCE-COUNTED (PR 11, prefix sharing): ``alloc`` hands a
+block out with one reference, :meth:`retain` adds holders (a prefix-cache
+hit maps the same physical block into another request's table, the radix
+tree itself holds one reference per cached block), :meth:`release` drops
+one — the block returns to the free list only when its LAST holder lets
+go. ``live`` counts UNIQUE referenced blocks, so the invariant becomes
+``free + sum(1 for each unique live block) == capacity`` — sharing never
+changes the total. A block with ``refcount > 1`` is READ-ONLY: the paged
+scatter must never write through it (the engine's copy-on-write guard
+forks first; lint rule DML211 enforces the ordering statically).
 """
 
 from __future__ import annotations
@@ -73,7 +84,7 @@ class KVBlockPool:
         # host half: low ids hand out first (pop from the end of a reversed
         # stack) — purely cosmetic determinism that makes tests readable
         self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
-        self._live: set[int] = set()
+        self._ref: dict[int, int] = {}  # live block -> reference count
 
     @classmethod
     def for_model(cls, cfg, *, num_blocks: int, block_size: int, dtype: Any = None) -> "KVBlockPool":
@@ -98,7 +109,19 @@ class KVBlockPool:
 
     @property
     def num_live(self) -> int:
-        return len(self._live)
+        """UNIQUE referenced blocks — a block mapped into three tables (or
+        pinned by the radix tree) still counts once, so ``free + live ==
+        capacity`` holds under arbitrary sharing."""
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        """Current holders of ``block`` (0 = free / not from this pool)."""
+        return self._ref.get(int(block), 0)
+
+    def is_shared(self, block: int) -> bool:
+        """More than one holder: the block is READ-ONLY — any write must
+        copy-on-write fork first (the DML211 contract)."""
+        return self._ref.get(int(block), 0) > 1
 
     def blocks_for(self, tokens: int) -> int:
         """Blocks needed to hold ``tokens`` cache slots."""
@@ -120,14 +143,16 @@ class KVBlockPool:
             "capacity": self.num_blocks,
             "free": self.num_free,
             "live": self.num_live,
+            "shared": sum(1 for c in self._ref.values() if c > 1),
             "block_size": self.block_size,
             "bytes_total": self.bytes_per_block() * self.num_blocks,
         }
 
-    # -- alloc / free --------------------------------------------------------
+    # -- alloc / retain / release --------------------------------------------
     def alloc(self, n: int) -> list[int]:
-        """Hand out ``n`` free blocks; raises :class:`PoolExhausted` (and
-        allocates nothing) when fewer than ``n`` are free."""
+        """Hand out ``n`` free blocks, each with ONE reference; raises
+        :class:`PoolExhausted` (and allocates nothing) when fewer than
+        ``n`` are free."""
         n = int(n)
         if n > len(self._free):
             raise PoolExhausted(
@@ -135,23 +160,54 @@ class KVBlockPool:
                 f"{self.num_blocks} free"
             )
         out = [self._free.pop() for _ in range(n)]
-        self._live.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
-    def free(self, blocks) -> None:
-        """Return blocks to the free list. A block that is not currently
-        live (double-free, or never allocated here) raises — silently
-        accepting it would corrupt the free list and hand the same page to
-        two sequences."""
+    def retain(self, blocks) -> None:
+        """Add one holder to each block (a prefix-cache hit mapping shared
+        blocks into a new table, or the radix tree pinning a cached
+        block). Retaining a block that is not live raises — a free block
+        has no content worth sharing, and silently resurrecting it would
+        hand a recycled page to two owners."""
         blocks = list(blocks)
         for b in blocks:
-            if b not in self._live:
+            if b not in self._ref:
                 raise ValueError(
-                    f"block {b} is not live (double-freed, or not from this pool)"
+                    f"block {b} is not live (cannot retain a free/foreign block)"
                 )
         for b in blocks:
-            self._live.remove(b)
-            self._free.append(b)
+            self._ref[b] += 1
+
+    def release(self, blocks) -> None:
+        """Drop one reference per block; a block whose LAST holder lets go
+        returns to the free list. Releasing a block that is not live, or
+        more times in one call than it has holders (double-release,
+        release-below-zero, or never allocated here) raises — and releases
+        NOTHING, so a bad call can never corrupt the free list or hand the
+        same page to two sequences."""
+        blocks = [int(b) for b in blocks]
+        counts: dict[int, int] = {}
+        for b in blocks:
+            counts[b] = counts.get(b, 0) + 1
+        for b, n in counts.items():
+            if self._ref.get(b, 0) < n:
+                raise ValueError(
+                    f"block {b} is not live (double-freed, released below zero, "
+                    "or not from this pool)"
+                )
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
+
+    def free(self, blocks) -> None:
+        """Back-compat alias of :meth:`release` — under refcounting,
+        "freeing" means dropping YOUR reference; the block only reaches
+        the free list when nobody else (another table, the radix tree)
+        still holds it."""
+        self.release(blocks)
 
     def swap(self, new_pools) -> None:
         """Install the jitted step's updated page arrays (the old leaves

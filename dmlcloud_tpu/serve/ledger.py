@@ -9,6 +9,13 @@ duration). ``summary()`` reduces them to the numbers a capacity planner
 asks for: p50/p99 TTFT, mean queue wait, served tokens/s over the busy
 window, and the queue-depth profile the engine samples once per step.
 
+Prefix sharing adds the cache observables: per request, the tokens the
+radix tree matched at admission (``cached_tokens``), the prefill tokens
+the skip actually saved (``saved_tokens`` — the divergence point), and
+the prompt length, reduced in ``summary()`` to the hit rate, the
+cached-token fraction and the prefill-tokens-saved fraction — the numbers
+the ``BENCH_serve_prefix_*`` receipt gates.
+
 Speculative serving adds the accept-rate observables: per request, the
 tokens the draft proposed (``drafted``) and the tokens the verifier
 accepted (``accepted``) — counters that arrive packed in the same device
@@ -59,6 +66,18 @@ class ServeLedger:
     def finished(self, rid: int, now: float) -> None:
         self.records[rid]["finished"] = now
 
+    def prefix_match(self, rid: int, cached: int, saved: int, prompt: int) -> None:
+        """The request's prefix-cache outcome at admission: ``cached``
+        tokens matched in the radix tree, ``saved`` prefill tokens
+        actually skipped (the divergence point — ``cached`` minus the one
+        re-fed token of an exact full-block match), out of ``prompt``
+        prompt tokens. Host bookkeeping only; the tree itself never
+        appears on device."""
+        rec = self.records[rid]
+        rec["cached_tokens"] = int(cached)
+        rec["saved_tokens"] = int(saved)
+        rec["prompt_tokens"] = int(prompt)
+
     def spec_round(self, rid: int, drafted: int, accepted: int) -> None:
         """One speculative verification round's counters for a request.
         The counts arrive packed in the SAME device fetch as the round's
@@ -101,6 +120,13 @@ class ServeLedger:
             t0 = min(r["arrival"] for r in self.records.values())
             t1 = max(r["finished"] for r in done)
             span = max(t1 - t0, 1e-9)
+        # prefix-cache observables (None on an engine without the cache):
+        # hit rate over admitted requests, fraction of prompt tokens served
+        # from cache, and the prefill tokens the skip actually saved
+        pref = [r for r in self.records.values() if "prompt_tokens" in r]
+        prompt_tok = sum(r["prompt_tokens"] for r in pref)
+        cached_tok = sum(r["cached_tokens"] for r in pref)
+        saved_tok = sum(r["saved_tokens"] for r in pref)
         drafted = sum(r.get("drafted", 0) for r in self.records.values())
         accepted = sum(r.get("accepted", 0) for r in self.records.values())
         rates = [
@@ -122,6 +148,18 @@ class ServeLedger:
             # speculative-decode counters (zero / None on a plain engine):
             # totals across requests plus the per-request mean — the
             # scorecard's accept-rate observable
+            # prefix-cache scorecard (None without prefix_cache=True)
+            "prefix_hit_rate": (
+                round(sum(1 for r in pref if r["cached_tokens"] > 0) / len(pref), 4)
+                if pref else None
+            ),
+            "cached_token_frac": (
+                round(cached_tok / prompt_tok, 4) if prompt_tok else None
+            ),
+            "prefill_tokens_saved": saved_tok if pref else None,
+            "prefill_tokens_saved_frac": (
+                round(saved_tok / prompt_tok, 4) if prompt_tok else None
+            ),
             "drafted_tokens": drafted,
             "accepted_tokens": accepted,
             "accept_rate": round(accepted / drafted, 4) if drafted else None,

@@ -31,6 +31,25 @@ holds its worst case in each, checked before either allocation so a
 failed admit leaks nothing) and frees both at finish — the ``free + live
 == capacity`` invariant holds per pool, always.
 
+Prefix sharing (``prefix_cache=``, serve/prefix_cache.py) changes the
+ACCOUNTING but not the contract: admission first locks the head's longest
+cached prefix (``match`` + ``lock`` — lock re-validates against races and
+pins each shared block with a retain, so the eviction below can never
+reclaim them), then needs only ``reservation - shared`` NEW blocks —
+shared blocks are discounted because the head already holds a reference
+to them. An exact full-block match rolls prefill back one token for its
+logits, which guarantees one copy-on-write fork, so ONE spare block is
+added back to the reservation in that case — full reservation stays
+exact and the starvation-freedom proof survives: every admitted request
+holds (a reference to) every block it can ever need, pinned shared
+prefixes become evictable the moment their holders finish, and the head
+admits as soon as ``free + evictable`` covers its discounted need. A
+failed admit releases the locked prefix before breaking, so strict FIFO
+never leaks a reference. The draft pool has NO tree: spec requests still
+reserve their full worst case there (draft prefill skips via the
+target's match length, leaving the skipped draft pages unwritten — the
+verifier guarantees token identity regardless).
+
 The scheduler is pure host-side bookkeeping (deques of :class:`_Sequence`
 records); the engine owns every device interaction.
 """
@@ -84,6 +103,12 @@ class _Sequence:
     first_token: float | None = None
     finished: float | None = None
     adapter_id: int = 0
+    # prefix-cache state: leading table entries mapped READ-ONLY from the
+    # radix tree (refcount > 1 is the ground truth; this count is the
+    # observable), matched tokens, and spare blocks reserved for COW forks
+    shared: int = 0
+    cached_tokens: int = 0
+    cow_spare: int = 0
     # resolved per-row sampling params (request value or engine default)
     temperature: float = 0.0
     top_k: int = 0
@@ -112,7 +137,9 @@ class Scheduler:
     """FIFO continuous-batching admission over one :class:`KVBlockPool`
     (plus the draft model's pool in speculative mode). ``lookahead`` is
     the per-round speculative overshoot reserved per request (``spec_k``
-    for a spec engine, 0 otherwise)."""
+    for a spec engine, 0 otherwise); ``prefix_cache`` is the engine's
+    :class:`~dmlcloud_tpu.serve.prefix_cache.PrefixCache` (None = no
+    sharing — the exact PR-8 accounting)."""
 
     def __init__(
         self,
@@ -122,6 +149,7 @@ class Scheduler:
         *,
         draft_pool: KVBlockPool | None = None,
         lookahead: int = 0,
+        prefix_cache=None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -131,6 +159,7 @@ class Scheduler:
             raise ValueError(f"lookahead must be >= 0, got {lookahead}")
         self.pool = pool
         self.draft_pool = draft_pool
+        self.prefix = prefix_cache
         self.lookahead = int(lookahead)
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
@@ -182,17 +211,47 @@ class Scheduler:
         head's full reservation fit — in EVERY pool, checked before
         either allocation so a partial admit can never leak blocks.
         Returns the newly admitted sequences (blocks already allocated,
-        prefill pending)."""
+        prefill pending).
+
+        With a prefix cache: the head's cached prefix is matched and
+        LOCKED first (lock pins the shared blocks, so the eviction that
+        follows can never reclaim what the head is about to map — the
+        match→admit race the property tests exercise), shared blocks are
+        discounted from the reservation, and an exact full-block match
+        adds one COW spare (divergence rolls back one token, so the final
+        shared block WILL be forked). When the discounted need still
+        exceeds the free list, LRU leaves are evicted; if that is not
+        enough, the locked prefix is released and the head waits — strict
+        FIFO, no leaked references."""
         admitted = []
         while self.waiting and self.active < self.max_slots:
             head = self.waiting[0]
             need = self.reservation(head)
-            if need > self.pool.num_free:
+            shared_blocks: list[int] = []
+            cached = 0
+            if self.prefix is not None:
+                shared_blocks, cached = self.prefix.lock(
+                    self.prefix.match(head.req.prompt, adapter=head.adapter_id),
+                    )
+            spare = 1 if cached >= head.prompt_len else 0  # guaranteed COW fork
+            need_new = need - len(shared_blocks) + spare
+            if self.prefix is not None and need_new > self.pool.num_free:
+                self.prefix.evict(need_new)  # leaf-first LRU; pinned blocks safe
+            short = need_new > self.pool.num_free or (
+                self.draft_pool is not None and need > self.draft_pool.num_free
+            )
+            if short:
+                if shared_blocks:
+                    self.pool.release(shared_blocks)  # unlock: no leaked refs
                 break  # strict FIFO: nobody may overtake the head
-            if self.draft_pool is not None and need > self.draft_pool.num_free:
-                break
             self.waiting.popleft()
-            head.blocks = self.pool.alloc(need)
+            head.blocks = shared_blocks + self.pool.alloc(need_new)
+            head.shared = len(shared_blocks)
+            head.cached_tokens = cached
+            head.cow_spare = spare
+            # chunked prefill starts at the divergence point; at least the
+            # final prompt token must run for its logits (first token)
+            head.fill = min(cached, head.prompt_len - 1)
             if self.draft_pool is not None:
                 head.draft_blocks = self.draft_pool.alloc(need)
             head.admitted = now

@@ -48,6 +48,7 @@ MODULES = [
     ("dmlcloud_tpu.models.speculative", "Speculative decoding: exact greedy or exact sampled, draft-verified."),
     ("dmlcloud_tpu.ops.paged_attention", "Paged KV gather/scatter indexing for the serving engine."),
     ("dmlcloud_tpu.serve.kv_pool", "Paged KV-cache block pool: device pages, host free list."),
+    ("dmlcloud_tpu.serve.prefix_cache", "Radix-tree prefix sharing: content-addressed, refcounted blocks."),
     ("dmlcloud_tpu.serve.scheduler", "Continuous-batching FIFO scheduler with chunked prefill."),
     ("dmlcloud_tpu.serve.engine", "ServeEngine: the continuous-batching serving loop."),
     ("dmlcloud_tpu.serve.adapters", "AdapterSet: multi-tenant LoRA serving, merge-free."),

@@ -14,14 +14,19 @@ engine on a pinned Markov trace with a trained target/draft pair
   measured draft accept rate, compiled-signature counts against the
   TraceGuard budgets, and the spec arm's mid-run recompile count (must
   be 0)
+- the PREFIX-CACHE arm: the ``prefix_cache=True`` engine vs the same
+  engine uncached on the pinned 80%-shared-template trace — warm-template
+  p50 TTFT (the near-zero-prefill headline), hit rate, prefill tokens
+  saved, token-identity to the uncached engine, 0 mid-run recompiles
 
 Thin CLI over ``bench.bench_serve`` (which runs ``bench.py --serve-child``
 CPU-pinned) so the committed receipt and an interactive investigation run
 the exact same workload. The receipt's flat ``gate`` section is what
 ``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares
-(``serve_*`` and ``serve_spec_*`` keys; missing metric = FAIL).
+(``serve_*``, ``serve_spec_*`` and ``serve_prefix_*`` keys, against EVERY
+committed BENCH_serve_*.json; missing metric = FAIL).
 
-    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_spec_pr10.json
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_prefix_pr11.json
 """
 
 import argparse

@@ -10,8 +10,12 @@
 #            resume (0 replayed steps), save-on-preempt latency,
 #            time-to-resume; a missing metric FAILS
 #   serve    the continuous-batching serving A/B (Poisson trace, engine vs
-#            serial generate) vs the last committed BENCH_serve_*.json —
-#            tokens/s speedup, engine tokens/s, p99 TTFT (lower-is-better)
+#            serial generate, the spec arm, the prefix-cache arm) vs EVERY
+#            committed BENCH_serve_*.json merged into one baseline (each
+#            key at its most recently committed value) — tokens/s speedup,
+#            p99 TTFT, serve_spec_* accept/speedup keys, serve_prefix_*
+#            warm-TTFT / hit-rate keys (latencies lower-is-better;
+#            every receipt's keys stay enforced, missing metric = FAIL)
 #   data     the streaming packed data plane A/B (mix -> pack_stream vs
 #            pad-to-max on the pinned ragged corpus) vs the last committed
 #            BENCH_data_*.json — packed tokens/s speedup, padding waste

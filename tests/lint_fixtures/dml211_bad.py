@@ -1,0 +1,33 @@
+"""DML211 bad fixture: paged scatters / block-table-entry writes with no
+preceding copy-on-write fork or refcount check, in code that handles
+SHARED blocks (prefix-cache machinery) — each write may land in a page
+other requests' tables map read-only, corrupting THEIR cached prefixes.
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+from dmlcloud_tpu.ops.paged_attention import scatter_tokens
+from dmlcloud_tpu.serve.prefix_cache import PrefixCache
+
+
+def unguarded_scatter(pool, tables, positions, values):
+    # this module handles shared blocks (PrefixCache above) but nothing
+    # checked the refcounts of the blocks `tables` names
+    return scatter_tokens(pool, tables, positions, values)  # BAD: no fork/check
+
+
+def aliased_scatter(pool, tables, positions, values, prefix_cache):
+    prefix_cache.match(positions)
+    scat = scatter_tokens
+    return scat(pool, tables, positions, values)  # BAD: alias-chased, unguarded
+
+
+def remap_table_entry(tables, row, idx, block):
+    tables[row, idx] = block  # BAD: table-entry write, no refcount check
+    return tables
+
+
+def guard_after_write(engine, seq, tables, block):
+    tables[0] = block  # BAD: the fork must come FIRST (tables are stale)
+    engine.cow_fork(seq, 0)
+    return tables

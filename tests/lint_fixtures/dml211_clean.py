@@ -1,0 +1,35 @@
+"""DML211 clean fixture: shared-block code whose every paged scatter /
+table-entry write is preceded by a copy-on-write fork or refcount check —
+and kernel code with no sharing machinery at all, which is out of scope
+(traced code cannot see host refcounts; its callers carry the contract).
+
+Static lint corpus — never imported or executed. Expected findings: 0.
+"""
+
+from dmlcloud_tpu.ops.paged_attention import scatter_tokens
+from dmlcloud_tpu.serve.prefix_cache import PrefixCache
+
+
+def guarded_scatter(engine, seq, pool, tables, positions, values):
+    engine.cow_guard(seq, 0, positions.shape[1])  # fork before the write
+    return scatter_tokens(pool, tables, positions, values)
+
+
+def refcount_checked_remap(pool, seq, tables, row, idx, block):
+    if pool.is_shared(seq.blocks[idx]):  # the check sanctions the write
+        block = pool.fork(seq.blocks[idx])
+    tables[row, idx] = block
+    return tables
+
+
+def fork_then_build_tables(engine, batch, tables, rows):
+    for seq in batch:
+        engine.cow_fork(seq, seq.fill, seq.fill + 1)
+    tables[: len(batch)] = rows  # serve/engine.py's ordering: guard, THEN tables
+    return tables
+
+
+def cache_lookup_only(prefix_cache, prompt):
+    # handles shared blocks but never writes: nothing to guard
+    match = prefix_cache.match(prompt)
+    return prefix_cache.lock(match)

@@ -376,6 +376,133 @@ def test_committed_serve_spec_receipt_satisfies_the_gate():
         assert key in gate
 
 
+# ------------------------------------------------ serve suite: prefix cache
+
+SERVE_PREFIX_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "serve_tokens_per_sec_speedup": 3.0,
+        "serve_engine_tokens_per_sec": 300.0,
+        "serve_p99_ttft_s": 1.5,
+        "serve_prefix_warm_ttft_s": 0.1,
+        "serve_prefix_hit_rate": 0.8,
+        "serve_prefix_prefill_tokens_saved_frac": 0.7,
+        "serve_prefix_token_identical": 1,
+        "serve_prefix_zero_recompiles": 1,
+    },
+}
+
+
+def test_serve_prefix_warm_ttft_is_lower_is_better(tmp_path, capsys):
+    """The warm-template TTFT is the tentpole's headline latency: growth
+    past the wide latency tolerance (the cache silently stopped hitting)
+    FAILS; shrinking always passes."""
+    slow = json.loads(json.dumps(SERVE_PREFIX_RECEIPT))
+    slow["gate"]["serve_prefix_warm_ttft_s"] = 0.1 * 2.5  # > 2x baseline
+    base = _write(tmp_path, "BENCH_serve_prefix_base.json", SERVE_PREFIX_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "serve_prefix_warm_ttft_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(SERVE_PREFIX_RECEIPT))
+    fast["gate"]["serve_prefix_warm_ttft_s"] = 0.01
+    assert run_gate(base, current=fast) == 0
+
+
+def test_serve_prefix_hit_rate_regression_fails(tmp_path, capsys):
+    """A collapsed hit rate (the radix tree stopped matching — e.g. a
+    content-address change orphaned every cached block) is a regression
+    like any ratio: dropping past tolerance FAILS."""
+    doctored = json.loads(json.dumps(SERVE_PREFIX_RECEIPT))
+    doctored["gate"]["serve_prefix_hit_rate"] = 0.1
+    doctored["gate"]["serve_prefix_prefill_tokens_saved_frac"] = 0.05
+    base = _write(tmp_path, "BENCH_serve_prefix_base.json", SERVE_PREFIX_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    out = capsys.readouterr().out
+    assert "serve_prefix_hit_rate" in out
+    assert "serve_prefix_prefill_tokens_saved_frac" in out
+
+
+def test_serve_prefix_identity_and_recompiles_are_pass_fail(tmp_path, capsys):
+    """Token identity to the uncached engine and the zero-recompile
+    contract ride the gate as 1/0 ints: flipping either is a 100% drop."""
+    for key in ("serve_prefix_token_identical", "serve_prefix_zero_recompiles"):
+        doctored = json.loads(json.dumps(SERVE_PREFIX_RECEIPT))
+        doctored["gate"][key] = 0
+        base = _write(tmp_path, f"BENCH_serve_{key}.json", SERVE_PREFIX_RECEIPT)
+        assert run_gate(base, current=doctored) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_serve_prefix_missing_metric_fails(tmp_path, capsys):
+    """PR-6 semantics: a prefix metric that silently vanishes from the
+    current run (the prefix arm stopped running) is a FAIL, not a pass."""
+    current = json.loads(json.dumps(SERVE_PREFIX_RECEIPT))
+    del current["gate"]["serve_prefix_warm_ttft_s"]
+    base = _write(tmp_path, "BENCH_serve_prefix_base.json", SERVE_PREFIX_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_serve_suite_merges_every_committed_receipt(tmp_path, monkeypatch):
+    """Without --baseline, the serve suite folds EVERY committed
+    BENCH_serve_*.json into one merged baseline, each key at its most
+    recently committed value — the pr11 receipt's prefix keys stay
+    enforced (missing = FAIL) while an older receipt's stale absolute
+    numbers do not resurrect as floors."""
+    import bench as bench_mod
+
+    old = {"gate": {"serve_p99_ttft_s": 1.5, "serve_tokens_per_sec_speedup": 3.0}}
+    new = {"gate": {"serve_tokens_per_sec_speedup": 2.0, "serve_prefix_hit_rate": 0.8}}
+    _write(tmp_path, "BENCH_serve_a.json", old)
+    _write(tmp_path, "BENCH_serve_b_prefix.json", new)
+    monkeypatch.setattr(
+        bench_mod.os.path, "dirname", lambda p, _real=bench_mod.os.path.dirname: str(tmp_path)
+    )
+    # current matches the NEWER speedup (2.0, a >15% drop from the stale
+    # 3.0): passes, because the later receipt's value won the merge
+    both = {"gate": {"serve_p99_ttft_s": 1.5, "serve_tokens_per_sec_speedup": 2.0,
+                     "serve_prefix_hit_rate": 0.8}}
+    cur = _write(tmp_path, "cur.json", both)
+    assert gate_main(["--gate", "--suite", "serve", "--current", cur]) == 0
+    # drop the prefix key: the merged baseline still carries it — FAIL
+    partial = _write(
+        tmp_path, "partial.json",
+        {"gate": {"serve_p99_ttft_s": 1.5, "serve_tokens_per_sec_speedup": 2.0}},
+    )
+    assert gate_main(["--gate", "--suite", "serve", "--current", partial]) == 1
+
+
+def test_committed_serve_prefix_receipt_satisfies_the_gate():
+    """The committed PR 11 receipt must pass its own gate and meet the
+    acceptance floors: warm-template p50 TTFT <= 0.25x the uncached
+    engine's on the 80%-shared-template trace, token-identical to the
+    uncached engine, zero mid-run recompiles, a real hit rate."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_prefix_pr11.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    prefix = receipt["prefix"]
+    # the ISSUE's acceptance criterion: warm p50 TTFT <= 0.25x uncached
+    assert prefix["warm_ttft_ratio"] <= 0.25
+    assert gate["serve_prefix_warm_ttft_s"] == prefix["warm_template_p50_ttft_s"]
+    assert prefix["warm_template_p50_ttft_s"] <= 0.25 * prefix["uncached_template_p50_ttft_s"]
+    assert gate["serve_prefix_hit_rate"] >= 0.7  # 80% shared minus cold misses
+    assert gate["serve_prefix_prefill_tokens_saved_frac"] >= 0.5
+    assert gate["serve_prefix_token_identical"] == 1
+    assert gate["serve_prefix_zero_recompiles"] == 1
+    assert prefix["token_identical_to_uncached"] is True
+    assert prefix["mid_run_recompiles"] == 0
+    eng = prefix["prefix_engine"]
+    assert eng["compiled_signatures"] <= eng["max_signatures"]
+    assert eng["completed"] == prefix["config"]["n_requests"]
+    # one receipt carries every serve key: the older suites stay enforced
+    for key in ("serve_tokens_per_sec_speedup", "serve_p99_ttft_s",
+                "serve_spec_speedup_vs_engine"):
+        assert key in gate
+
+
 def test_committed_elastic_receipt_satisfies_the_gate():
     """The committed PR 7 receipt must pass its own gate and certify exact
     resumption: 0 steps replayed, a resumable preemption verdict."""

@@ -46,6 +46,7 @@ BAD_EXPECT = {
     "DML208": 4,
     "DML209": 5,
     "DML210": 4,
+    "DML211": 4,
     "DML301": 2,
     "DML302": 2,
 }
