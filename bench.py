@@ -1346,11 +1346,141 @@ def _serve_prefix_section():
     }
 
 
+#: the CPU-smoke overload/chaos drill config — pinned so receipts stay
+#: comparable. Engine geometry rides _SERVE_CFG; the trace is adversarial
+#: by construction: one HOT tenant bursts 16 requests at t~0 against a
+#: 6-deep admission queue (forcing oldest-deadline shedding) while one
+#: COLD tenant trickles 4 requests behind it — deficit round-robin
+#: fairness is what keeps the cold tenant's TTFT flat under the burst
+#: (the gated ``serve_chaos_cold_p99_ttft_s``). A seeded ChaosMonkey
+#: injects step faults, pool-exhaustion squats and random cancels during
+#: the replay; the receipt proves goodput under fire, zero leaked blocks,
+#: and that SURVIVORS (status ``ok``) are greedy-token-identical to a
+#: fault-free run. Cold requests carry priority 1 (hot 0) so the shed
+#: policy prefers hot victims; hot deadlines give oldest-deadline a key.
+_SERVE_CHAOS_CFG = dict(
+    hot_requests=16, cold_requests=4,
+    hot_burst_s=0.005, cold_start_s=0.05, cold_spacing_s=0.2,
+    prompt_lens=(16, 32, 48), new_tokens=(24, 32),
+    hot_deadline_s=8.0, max_waiting=6, shed_policy="oldest-deadline",
+    fairness="tenant", seed=0,
+    chaos_seed=7, p_fault=0.06, max_faults=3,
+    p_exhaust=0.12, exhaust_blocks=8, exhaust_steps=2, p_cancel=0.04,
+)
+
+
+def _serve_chaos_trace():
+    """The pinned two-tenant adversarial trace: (offset_s, prompt,
+    max_new, submit-kwargs) per request, offsets ascending."""
+    c, sc = _SERVE_CHAOS_CFG, _SERVE_CFG
+    rs = np.random.RandomState(c["seed"])
+
+    def prompt(i):
+        return rs.randint(
+            0, sc["vocab"], size=c["prompt_lens"][i % len(c["prompt_lens"])]
+        ).astype(np.int32)
+
+    trace = []
+    for i in range(c["hot_requests"]):
+        trace.append((
+            i * c["hot_burst_s"], prompt(i),
+            c["new_tokens"][i % len(c["new_tokens"])],
+            {"tenant": "hot", "deadline_s": c["hot_deadline_s"], "priority": 0},
+        ))
+    for j in range(c["cold_requests"]):
+        trace.append((
+            c["cold_start_s"] + j * c["cold_spacing_s"], prompt(j),
+            c["new_tokens"][j % len(c["new_tokens"])],
+            {"tenant": "cold", "priority": 1},
+        ))
+    trace.sort(key=lambda e: e[0])
+    return trace
+
+
+def _serve_chaos_section():
+    """The overload/chaos drill (ISSUE 13's receipt): the bounded-queue,
+    tenant-fair engine replays the adversarial two-tenant trace with a
+    seeded ChaosMonkey attached. Returns the results dict whose numbers
+    feed the ``serve_chaos_*`` gate keys: goodput under fire, cold-tenant
+    p99 TTFT (fairness' observable), zero leaked blocks after the drill,
+    every request terminal, and survivors greedy-token-identical to the
+    fault-free reference arm."""
+    from dmlcloud_tpu.serve import ChaosMonkey, ServeEngine, TERMINAL_STATUSES
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c, sc = _SERVE_CHAOS_CFG, _SERVE_CFG
+    model, params = _serve_model()
+    trace = _serve_chaos_trace()
+    n = len(trace)
+
+    def engine_kw():
+        return dict(
+            num_blocks=sc["num_blocks"], block_size=sc["block_size"],
+            max_slots=sc["max_slots"], prefill_chunk=sc["prefill_chunk"],
+        )
+
+    # reference arm: same prompts, no limits, no faults — greedy decode is
+    # batch-composition-independent, so these are the outputs every chaos
+    # SURVIVOR must reproduce bit-for-bit
+    ref = ServeEngine(model, params, **engine_kw())
+    ref.serve_trace([(0.0, p, new) for _, p, new, _ in trace])
+    ref_outs = [ref.output(i) for i in range(n)]
+
+    eng = ServeEngine(
+        model, params, **engine_kw(),
+        shed_policy=c["shed_policy"], fairness=c["fairness"],
+    )
+    # warm pass with the admission bound lifted: compiles every signature
+    # without shedding, so the measured replay's latencies are compile-free
+    eng.serve_trace([(0.0, p, new) for _, p, new, _ in trace])
+    eng.scheduler.max_waiting = c["max_waiting"]
+    eng.ledger = ServeLedger()
+
+    monkey = ChaosMonkey(
+        c["chaos_seed"], p_fault=c["p_fault"], max_faults=c["max_faults"],
+        p_exhaust=c["p_exhaust"], exhaust_blocks=c["exhaust_blocks"],
+        exhaust_steps=c["exhaust_steps"], p_cancel=c["p_cancel"],
+    )
+    monkey.attach(eng)
+    summary = eng.serve_trace(trace)
+    monkey.detach()
+    leaked = eng.leaked_blocks()
+
+    # the measured replay's requests are ids n..2n-1 (the warm pass took 0..n-1)
+    statuses = [eng.status(n + i) for i in range(n)]
+    all_terminal = all(s in TERMINAL_STATUSES for s in statuses)
+    survivors = [i for i, s in enumerate(statuses) if s == "ok"]
+    identical = all(
+        np.array_equal(eng.output(n + i), ref_outs[i]) for i in survivors
+    )
+    cold_ttfts = eng.ledger.ttfts(tenant="cold")
+    cold_p99 = (
+        round(float(np.percentile(cold_ttfts, 99)), 4) if cold_ttfts else None
+    )
+    rnd = lambda d: {
+        k: (round(v, 4) if isinstance(v, float) else v) for k, v in d.items()
+    }
+    return {
+        "config": dict(c),
+        "summary": rnd(summary),
+        "statuses": eng.ledger.status_counts(),
+        "injected_faults": int(monkey.faults),
+        "chaos_events": len(monkey.log),
+        "survivors_ok": len(survivors),
+        "leaked_blocks": int(leaked),
+        "survivor_token_identical": bool(identical),
+        "all_terminal": bool(all_terminal),
+        "goodput_tokens_per_sec": summary["goodput_tokens_per_sec"],
+        "cold_p99_ttft_s": cold_p99,
+    }
+
+
 def serve_child_main():
     """A/B the continuous-batching engine against serial ``generate()`` on
     the pinned Poisson trace, then the speculative engine against the
     plain engine on the pinned Markov trace, then the prefix-cache engine
-    against the uncached engine on the pinned 80%-shared-template trace
+    against the uncached engine on the pinned 80%-shared-template trace,
+    then the overload/chaos drill on the adversarial two-tenant trace
     (CPU-pinned child); prints one marker line of JSON — the source of
     ``BENCH_serve_*.json`` and of ``bench.py --gate --suite serve``'s
     current numbers."""
@@ -1386,6 +1516,7 @@ def serve_child_main():
     )
     spec = _spec_serve_section()
     prefix = _serve_prefix_section()
+    chaos = _serve_chaos_section()
     results = {
         "config": dict(c),
         "value_source": "cpu_smoke",
@@ -1399,6 +1530,7 @@ def serve_child_main():
         "token_identical_to_serial": identical,
         "spec": spec,
         "prefix": prefix,
+        "chaos": chaos,
         # the flat, schema-stable section the perf gate compares
         "gate": {
             "serve_tokens_per_sec_speedup": speedup,
@@ -1422,6 +1554,16 @@ def serve_child_main():
             "serve_prefix_prefill_tokens_saved_frac": prefix["prefill_tokens_saved_frac"],
             "serve_prefix_token_identical": int(bool(prefix["token_identical_to_uncached"])),
             "serve_prefix_zero_recompiles": int(prefix["mid_run_recompiles"] == 0),
+            # overload/chaos drill (ISSUE 13): goodput under injected
+            # faults, the cold tenant's p99 TTFT under a hot-tenant burst
+            # as a lower-is-better latency, and the robustness contracts
+            # (zero leaked blocks, every request terminal, survivors
+            # greedy-token-identical to a fault-free run) as pass/fail ints
+            "serve_chaos_goodput_tokens_per_sec": chaos["goodput_tokens_per_sec"],
+            "serve_chaos_cold_p99_ttft_s": chaos["cold_p99_ttft_s"],
+            "serve_chaos_zero_leaked_blocks": int(chaos["leaked_blocks"] == 0),
+            "serve_chaos_survivor_token_identical": int(bool(chaos["survivor_token_identical"])),
+            "serve_chaos_all_terminal": int(bool(chaos["all_terminal"])),
         },
     }
     print(_SERVE_MARKER + json.dumps(results), flush=True)
@@ -1688,6 +1830,7 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "serve_p99_ttft_s",
         "serve_spec_p99_ttft_s",
         "serve_prefix_warm_ttft_s",
+        "serve_chaos_cold_p99_ttft_s",
         "data_wait_s",
     }
 )

@@ -28,6 +28,12 @@ contracts that keep them fast checkable on CPU:
           requests' tables — writing through it silently corrupts every
           other reader's cached prefix, a cross-request correctness bug
           no test on the writing request can see
+- DML212  in serving-lifecycle code, a ``try/except`` around a serve
+          step call (or a request's transition to a terminal status)
+          whose handler neither frees pool blocks nor routes the request
+          through the lifecycle's exit path — the leak-on-error hazard:
+          the swallowed failure strands the request live and its pages
+          (COW spare, prefix locks) stay allocated forever
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -64,6 +70,7 @@ __all__ = [
     "check_cache_alloc_in_loop",
     "check_counter_readback_in_loop",
     "check_unguarded_shared_block_write",
+    "check_leaky_failure_handler",
 ]
 
 
@@ -624,5 +631,158 @@ def check_scan_remat(ctx: ModuleCtx):
                 "scan over a layer stack without a remat policy: every layer's "
                 "activations are saved for the backward — wrap the scan body in "
                 "jax.checkpoint (jax.remat) so activation memory stays O(1) layers",
+                fn_name,
+            )
+
+
+# ------------------------------------------------------------------- DML212
+
+#: identifiers that mark a module as SERVING-LIFECYCLE code — the engine,
+#: its block pools, chunked prefill / bucketed decode. Only such modules
+#: are in scope: a TRAINING loop's try around its step function has its
+#: own recovery contract (checkpoint + requeue verdict), not a block pool
+#: holding pages on behalf of the failed work.
+_SERVE_LIFECYCLE_VOCAB = re.compile(
+    r"(?i)(serve_?engine|serve_?ledger|kv_?block_?pool|pool_?exhausted"
+    r"|prefill_?chunk|chunked_?prefill|decode_?batch|prefix_?cache"
+    r"|continuous_?batching|paged_?kv|block_?tables?)"
+)
+
+#: a call whose terminal name is the serving step family — the calls whose
+#: failure strands requests mid-flight, pages still allocated
+_STEPLIKE_CALL = re.compile(
+    r"(?i)(^|_)(step|prefill|decode|draft|verify)"
+    r"(_fn|_chunk|_batch|_step|_spec|_round|_tokens)?$"
+)
+
+#: handler calls that COUNT as routing the failure into the request
+#: lifecycle: releasing pages, stamping a terminal status through the one
+#: exit path, shedding, or degrading the round
+_LIFECYCLE_SANCTION = re.compile(
+    r"(?i)(release|free|terminate|fail|abort|shed|finish|cancel|drop|unlock|degrade)"
+)
+
+#: the request state machine's terminal statuses (serve/scheduler.py) —
+#: an assignment of one of these inside a try body is a state transition
+#: whose failure handler must not swallow the exception without cleanup
+_TERMINAL_STATUS_VALUES = frozenset(
+    {"ok", "cancelled", "deadline_exceeded", "shed", "error"}
+)
+
+
+def _module_is_serving_lifecycle(ctx: ModuleCtx) -> bool:
+    """Whether the module's IDENTIFIERS (names, attributes, imports,
+    parameters, keywords — never docstrings or comments) mention the
+    serving-lifecycle machinery."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and _SERVE_LIFECYCLE_VOCAB.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _SERVE_LIFECYCLE_VOCAB.search(node.attr):
+            return True
+        if isinstance(node, ast.keyword) and node.arg and _SERVE_LIFECYCLE_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, ast.arg) and _SERVE_LIFECYCLE_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            if isinstance(node, ast.ImportFrom) and node.module:
+                names.append(node.module)
+            if any(_SERVE_LIFECYCLE_VOCAB.search(n) for n in names):
+                return True
+    return False
+
+
+def _try_own_body(node: ast.Try):
+    """Every node of ``node.body``'s own scope: nested ``try`` blocks own
+    their handling (they are examined on their own) and nested ``def``/
+    ``lambda`` bodies run later, outside these handlers — both excluded.
+    ``orelse``/``finally`` are excluded too: exceptions raised there are
+    NOT caught by this try's handlers."""
+    stack = list(node.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _step_hazard(node: ast.Try) -> str | None:
+    """What makes this try a lifecycle hazard: the first step-family call
+    or terminal-status store in its (own-scope) body, else None."""
+    for n in _try_own_body(node):
+        if isinstance(n, ast.Call):
+            func = n.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name and _STEPLIKE_CALL.search(name):
+                return f"step call '{name}(...)'"
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Constant):
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "status"
+                    and n.value.value in _TERMINAL_STATUS_VALUES
+                ):
+                    return f"terminal-status transition 'status = {n.value.value!r}'"
+    return None
+
+
+def _handler_routes_failure(handler: ast.excepthandler) -> bool:
+    """Whether the except handler routes the failure into the lifecycle:
+    any ``raise`` (escalation — the caller's handler owns the cleanup) or
+    a call naming the contract (release/free/terminate/fail/shed/finish/
+    cancel/degrade — the one-exit-path family that frees pool blocks, COW
+    spares and prefix locks)."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            func = n.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            if name and _LIFECYCLE_SANCTION.search(name):
+                return True
+    return False
+
+
+@rule("DML212", "serve step failure handler that neither frees blocks nor stamps a terminal status")
+def check_leaky_failure_handler(ctx: ModuleCtx):
+    """In serving-lifecycle code (the engine, its pools, chunked prefill /
+    bucketed decode), a ``try/except`` around a step-family call — or
+    around a request's transition to a terminal status — whose handler
+    neither releases pool pages nor routes the request through the
+    lifecycle's exit path is the leak-on-error hazard: the exception is
+    swallowed, the request never reaches a terminal status, and its
+    blocks (plus any COW spare and prefix locks) stay allocated forever —
+    the pool bleeds capacity on exactly the nights failures cluster. The
+    handler must either escalate (``raise``) or name the contract: a
+    release/free call, or the one exit path that stamps the terminal
+    status and frees everything (``Scheduler.terminate`` /
+    ``ServeEngine._fail`` / ``_degrade_round``). Training modules are out
+    of scope — their step failures are the checkpoint/requeue contract's
+    ground, not a block pool's."""
+    if not _module_is_serving_lifecycle(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        hazard = _step_hazard(node)
+        if hazard is None:
+            continue
+        fn_name = getattr(ctx.enclosing_function(node), "name", "")
+        for handler in node.handlers:
+            if _handler_routes_failure(handler):
+                continue
+            yield _f(
+                ctx, "DML212", handler,
+                f"failure handler around {hazard} neither frees blocks nor "
+                "stamps a terminal status: the request is stranded live with "
+                "its pages (and any COW spare / prefix locks) still allocated "
+                "— route it through the one exit path (Scheduler.terminate / "
+                "ServeEngine._fail, which releases everything), degrade the "
+                "round, or re-raise",
                 fn_name,
             )

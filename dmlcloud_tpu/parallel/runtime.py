@@ -657,6 +657,9 @@ class PreemptionGuard:
         self.triggered = False
         #: the signal name that tripped the guard, for the requeue verdict
         self.signal_name: str | None = None
+        #: monotonic (perf_counter) instant the guard tripped — drain
+        #: budgets (e.g. the serve engine's) are measured from here
+        self.triggered_at: float | None = None
         #: whether coordinated() participates in the cross-rank gather
         self.armed = False
         self._prev: dict = {}
@@ -671,12 +674,16 @@ class PreemptionGuard:
             self._prev.setdefault(sig, prev)
         self.triggered = False
         self.signal_name = None
+        self.triggered_at = None
         self.armed = True
         return self
 
     def _handler(self, signum, frame):
         # flag only — the normal control path reports the drain
+        import time as _time
+
         self.triggered = True
+        self.triggered_at = _time.perf_counter()
         try:
             import signal as _signal
 

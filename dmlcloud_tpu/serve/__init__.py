@@ -24,7 +24,21 @@ static batch per call; this package turns it into a serving engine:
 - :class:`ServeLedger` (ledger.py): TTFT / per-token / queue-depth
   latency accounting plus drafted/accepted counters and accept rates,
   journal span kinds ``queue_wait`` / ``prefill`` / ``decode_batch`` /
-  ``draft`` / ``verify``.
+  ``draft`` / ``verify`` (``fault`` / ``drain`` on the failure paths);
+  bounded retention (``max_records``) keeps the aggregates exact while
+  per-request detail evicts FIFO.
+- **Overload control & failure semantics** (scheduler.py + engine.py):
+  per-request ``deadline_s`` / ``priority`` / ``tenant``, ``cancel(rid)``
+  at any phase, one terminal status per request (``ok | cancelled |
+  deadline_exceeded | shed | error``), bounded admission queue with load
+  shedding (``max_waiting`` + ``shed_policy``), per-tenant deficit-
+  round-robin fairness (``fairness="tenant"``), per-request fault
+  isolation and graceful drain (``drain()`` — admission stops, in-flight
+  work finishes inside ``drain_budget_s``, the ``requeue.json`` verdict
+  is written).
+- :class:`ChaosMonkey` (chaos.py): seeded deterministic fault injection
+  — step exceptions, pool-exhaustion squats, slow-clock stalls, random
+  cancels — the drill that proves the above under fire.
 
 Quick start::
 
@@ -40,14 +54,17 @@ See doc/serving.md for the architecture, memory math and bench receipts.
 """
 
 from .adapters import AdapterSet
+from .chaos import ChaosError, ChaosMonkey
 from .engine import ServeEngine
 from .kv_pool import KVBlockPool, PoolExhausted
 from .ledger import ServeLedger
 from .prefix_cache import PrefixCache, PrefixMatch
-from .scheduler import Request, Scheduler
+from .scheduler import Request, Scheduler, TERMINAL_STATUSES
 
 __all__ = [
     "AdapterSet",
+    "ChaosError",
+    "ChaosMonkey",
     "KVBlockPool",
     "PoolExhausted",
     "PrefixCache",
@@ -56,4 +73,5 @@ __all__ = [
     "Scheduler",
     "ServeEngine",
     "ServeLedger",
+    "TERMINAL_STATUSES",
 ]

@@ -52,6 +52,27 @@ arrays (``models.generate.sample_logits_batched``), so one engine serves
 mixed greedy/sampled tenants in a single batch; greedy rows stay
 bit-identical to serial ``generate()``.
 
+**Failure semantics.** Every request ends in exactly one terminal status
+(``ok | cancelled | deadline_exceeded | shed | error`` — see
+:data:`~dmlcloud_tpu.serve.scheduler.TERMINAL_STATUSES`), through ONE
+exit path (``Scheduler.terminate``) that releases both pools, the COW
+spare and any prefix-cache locks at ANY phase — queued, mid-chunked-
+prefill, mid-decode, mid-spec-round. A step failure is isolated to the
+request(s) it was advancing: the engine catches it, fails those rows
+(status ``error``, blocks freed, a ``fault`` span in the journal) and
+keeps serving everyone else — greedy survivors stay token-identical to
+an un-injected run (``serve/chaos.py`` proves this deterministically).
+A failed DRAFT step degrades that round to plain decode instead (the
+draft is an optimization; losing one round costs accept-rate
+bookkeeping nothing). Overload control bounds the admission queue
+(``max_waiting`` + ``shed_policy``) and a per-tenant deficit-round-robin
+mode (``fairness="tenant"``) keeps a hot tenant from starving cold ones.
+Graceful drain (:meth:`ServeEngine.drain`, or automatically when the
+installed ``PreemptionGuard`` trips mid-``step``) stops admission, sheds
+the queue, lets in-flight work finish inside ``drain_budget_s`` (then
+sheds it too) and writes the ``requeue.json`` verdict every elasticity
+wrapper already reads (doc/elasticity.md).
+
 **Zero mid-run recompiles, by construction.** Every device call's shape
 signature is ``(batch_bucket, table_bucket)`` for decode (each of the
 draft and verify steps in spec mode) and ``(1, prefill_chunk,
@@ -80,9 +101,10 @@ decode win), with no per-call preparation left in the loop.
 
 from __future__ import annotations
 
+import collections
 import functools
 import time
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -274,6 +296,17 @@ class ServeEngine:
         rng: jax.Array | None = None,
         guard: str = "raise",
         cache_dtype: Any = None,
+        max_waiting: int | None = None,
+        shed_policy: str = "reject",
+        fairness: str = "fifo",
+        drr_quantum: int | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+        run_dir: Any = None,
+        drain_budget_s: float = 5.0,
+        preemption=None,
+        watchdog=None,
+        max_done: int | None = None,
+        ledger_max_records: int | None = None,
     ):
         from ..models.quant import prepare_decode_params
 
@@ -319,8 +352,10 @@ class ServeEngine:
             self.pool, max_slots, prefill_chunk,
             draft_pool=self.draft_pool, lookahead=self.spec_k,
             prefix_cache=self.prefix,
+            max_waiting=max_waiting, shed_policy=shed_policy,
+            fairness=fairness, drr_quantum=drr_quantum,
         )
-        self.ledger = ServeLedger()
+        self.ledger = ServeLedger(max_records=ledger_max_records)
         self.adapters = adapters
         self.eos_id = int(eos_id)
         self._temperature = float(temperature)
@@ -330,6 +365,25 @@ class ServeEngine:
         self._calls = 0
         self._next_id = 0
         self._done: dict[int, _Sequence] = {}
+        # lifecycle state: every known sequence by id (live + retained
+        # terminal), terminal ids in finish order (the retention bound),
+        # the injectable clock the whole loop reads, and drain/fault knobs
+        self._all: dict[int, _Sequence] = {}
+        self._terminal: collections.deque[int] = collections.deque()
+        self._max_done = None if max_done is None else int(max_done)
+        self.clock = clock
+        self.run_dir = run_dir
+        self.drain_budget_s = float(drain_budget_s)
+        self.preemption = preemption
+        self.watchdog = watchdog
+        #: chaos hook: ``fn(point, seqs)`` called at "step" (must not
+        #: raise) and before each device phase ("prefill"/"decode"/
+        #: "draft"/"verify", where raising injects a fault) — serve/chaos.py
+        self.fault_injector: Callable[[str, Any], None] | None = None
+        self._drain_reason: str | None = None
+        self._drain_kind = "completed"
+        self._drain_requeue = False
+        self._drain_started: float | None = None
 
         self.batch_buckets = (
             resolve_buckets(batch_buckets) if batch_buckets else _pow2_buckets(max_slots)
@@ -357,10 +411,13 @@ class ServeEngine:
 
         if self.spec_k:
             #: spec-mode signature budget: prefill is (1, chunk) x table
-            #: bucket x {target, draft} through _paged_step; each decode
-            #: round is one draft + one verify signature per (batch bucket
-            #: x table bucket). TraceGuard turns any growth into an error.
-            self._step_budget = 2 * n_tb
+            #: bucket x {target, draft} through _paged_step, PLUS the plain
+            #: decode signatures a draft-failure degraded round replays
+            #: (batch bucket x table bucket — failure isolation must never
+            #: trip the retrace guard); each healthy decode round is one
+            #: draft + one verify signature per (batch bucket x table
+            #: bucket). TraceGuard turns any growth into an error.
+            self._step_budget = 2 * n_tb + n_bb * n_tb
             self._spec_budget = n_bb * n_tb
             self.max_signatures = self._step_budget + 2 * self._spec_budget
             self._draft_fn = _guarded(_spec_draft_step, self._spec_budget, "serve_spec_draft")
@@ -391,13 +448,25 @@ class ServeEngine:
         top_k: int | None = None,
         top_p: float | None = None,
         eos_id: int | None = None,
+        deadline_s: float | None = None,
+        priority: int = 0,
+        tenant: str | None = None,
     ) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D int32
         token sequence (no padding — paged rows sit at their own absolute
         positions, ragged prompts are the natural case). The sampling
         knobs override the engine defaults FOR THIS REQUEST ONLY — they
         are data to the compiled step, so a batch may mix greedy and
-        sampled tenants freely."""
+        sampled tenants freely.
+
+        ``deadline_s`` is a budget relative to NOW; a request that has
+        not finished when it elapses terminates ``deadline_exceeded`` at
+        whatever phase it is in. ``priority`` matters only to shed-victim
+        selection under overload (lower sheds first). ``tenant`` keys the
+        fairness scheduler (default: the adapter name, else one shared
+        tenant). Submission can itself shed — the returned id's status
+        may already be ``shed`` when the bounded queue chose the arrival
+        as the victim."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -416,22 +485,40 @@ class ServeEngine:
             if self.adapters is None:
                 raise ValueError("request names an adapter but the engine has no AdapterSet")
             aid = self.adapters.id_of(adapter)
-        now = time.perf_counter()
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        now = self.clock()
         rid = self._next_id
         self._next_id += 1
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter,
-            temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id, id=rid,
+            temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+            deadline_s=deadline_s, priority=int(priority), tenant=tenant, id=rid,
         )
+        resolved_tenant = tenant if tenant is not None else (adapter or "")
         seq = _Sequence(
             req=req, arrival=now, adapter_id=aid,
+            deadline=None if deadline_s is None else now + float(deadline_s),
+            tenant=resolved_tenant, priority=int(priority),
             temperature=self._temperature if temperature is None else float(temperature),
             top_k=self._top_k if top_k is None else int(top_k),
             top_p=self._top_p if top_p is None else float(top_p),
             eos_id=self.eos_id if eos_id is None else int(eos_id),
         )
-        self.ledger.arrived(rid, now)
-        self.scheduler.submit(seq)
+        if self.draining:
+            # drain contract: admission is closed — arrivals shed on sight
+            self.ledger.arrived(rid, now, tenant=resolved_tenant)
+            self._all[rid] = seq
+            self._finalize(seq, now, "shed")
+            return rid
+        shed = self.scheduler.submit(seq)  # validates; raising records nothing
+        self.ledger.arrived(rid, now, tenant=resolved_tenant)
+        self._all[rid] = seq
+        for victim in shed:
+            # bounded-queue overflow: the scheduler picked the victim but
+            # the engine owns its terminal bookkeeping (it may be ``seq``
+            # itself, never enqueued, or a queued request holding nothing)
+            self._finalize(victim, now, "shed")
         return rid
 
     def output(self, rid: int) -> np.ndarray:
@@ -440,6 +527,67 @@ class ServeEngine:
 
     def results(self) -> dict[int, np.ndarray]:
         return {rid: self.output(rid) for rid in self._done}
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a live request at WHATEVER phase it is in — queued,
+        mid-chunked-prefill, mid-decode, mid-spec-round. Its blocks (both
+        pools), COW spare and prefix locks release immediately; status
+        becomes ``cancelled``. Returns False when the request is unknown
+        or already terminal (cancellation lost the race — idempotent, no
+        double-free)."""
+        seq = self._all.get(rid)
+        if seq is None or seq.status is not None:
+            return False
+        return self._finalize(seq, self.clock(), "cancelled")
+
+    def status(self, rid: int) -> str:
+        """The request's phase: ``queued`` / ``running`` while live, else
+        its terminal status (``ok | cancelled | deadline_exceeded | shed
+        | error``)."""
+        seq = self._all.get(rid)
+        if seq is None:
+            raise KeyError(f"unknown (or retention-evicted) request id {rid}")
+        if seq.status is not None:
+            return seq.status
+        return "queued" if seq.admitted is None else "running"
+
+    def statuses(self) -> dict[int, str]:
+        """Every retained request's :meth:`status`, by id."""
+        return {rid: self.status(rid) for rid in self._all}
+
+    # -- terminal bookkeeping ------------------------------------------------
+    def _finalize(self, seq, now: float, status: str, error: str | None = None) -> bool:
+        """The engine half of the ONE exit path: scheduler terminate
+        (queue removal + every block released), then ledger/journal/
+        retention. False when already terminal (idempotent)."""
+        if not self.scheduler.terminate(seq, now, status):
+            return False
+        self._record_terminal(seq, now, error)
+        return True
+
+    def _record_terminal(self, seq, now: float, error: str | None = None) -> None:
+        rid = seq.req.id
+        self.ledger.finished(rid, now, status=seq.status)
+        if seq.status == "error":
+            journal.emit("fault", now, label=f"req{rid}", request=rid,
+                         error=error or "")
+        if seq.status == "ok":
+            self._done[rid] = seq
+        self._terminal.append(rid)
+        if self._max_done is not None:
+            while len(self._terminal) > self._max_done:
+                old = self._terminal.popleft()
+                self._done.pop(old, None)
+                self._all.pop(old, None)
+
+    def _fail(self, seqs, exc: BaseException) -> None:
+        """Isolate a step failure to the request(s) it was advancing:
+        status ``error``, every resource released, everyone else keeps
+        serving."""
+        now = self.clock()
+        msg = f"{type(exc).__name__}: {exc}"
+        for s in seqs:
+            self._finalize(s, now, "error", error=msg)
 
     @property
     def idle(self) -> bool:
@@ -460,35 +608,67 @@ class ServeEngine:
 
     # -- the serving loop ----------------------------------------------------
     def step(self) -> bool:
-        """One engine iteration: admit, one prefill chunk, one decode
-        batch (a speculative round when ``spec_k``). Returns whether any
-        device work ran."""
-        now = time.perf_counter()
-        for seq in self.scheduler.admit(now):
-            self.ledger.admitted(seq.req.id, now)
-            if self.prefix is not None:
-                # prefill-skip accounting: saved = the divergence point the
-                # scheduler rolled prefill forward to (cached tokens, minus
-                # the one re-fed token of an exact full-block match)
-                self.ledger.prefix_match(
-                    seq.req.id, cached=seq.cached_tokens, saved=seq.fill,
-                    prompt=seq.prompt_len,
-                )
-            journal.emit("queue_wait", seq.arrival, now, label=f"req{seq.req.id}",
-                         request=seq.req.id, depth=self.scheduler.depth())
+        """One engine iteration: expire deadlines, admit (or drain), one
+        prefill chunk, one decode batch (a speculative round when
+        ``spec_k``). Returns whether any device work ran. A failure in
+        either device phase is isolated to the request(s) it was
+        advancing — the step itself never raises for a per-request
+        fault."""
+        now = self.clock()
+        if self.watchdog is not None:
+            self.watchdog.notify()
+        self._chaos("step", None)
+        for seq in self.scheduler.expire(now):
+            # the scheduler already terminated them (blocks released);
+            # the engine owns the ledger/journal tail
+            self._record_terminal(seq, now)
+        if (
+            self.preemption is not None
+            and self.preemption.triggered
+            and not self.draining
+        ):
+            self.request_drain(
+                f"preemption:{self.preemption.signal_name}",
+                kind="preemption", requeue=True,
+            )
+        if self.draining:
+            self._drain_step(now)
+        else:
+            for seq in self.scheduler.admit(now):
+                self.ledger.admitted(seq.req.id, now)
+                if self.prefix is not None:
+                    # prefill-skip accounting: saved = the divergence point the
+                    # scheduler rolled prefill forward to (cached tokens, minus
+                    # the one re-fed token of an exact full-block match)
+                    self.ledger.prefix_match(
+                        seq.req.id, cached=seq.cached_tokens, saved=seq.fill,
+                        prompt=seq.prompt_len,
+                    )
+                journal.emit("queue_wait", seq.arrival, now, label=f"req{seq.req.id}",
+                             request=seq.req.id, depth=self.scheduler.depth())
         did = False
         seq = self.scheduler.next_prefill()
         if seq is not None:
-            self._prefill_chunk(seq)
+            try:
+                self._prefill_chunk(seq)
+            except Exception as exc:  # noqa: BLE001 — isolate to this request
+                self._fail([seq], exc)
             did = True
         batch = self.scheduler.decode_batch()
         if batch:
-            if self.spec_k:
-                self._decode_spec(batch)
-            else:
-                self._decode(batch)
+            try:
+                if self.spec_k:
+                    self._decode_spec(batch)
+                else:
+                    self._decode(batch)
+            except Exception as exc:  # noqa: BLE001 — isolate to these rows
+                self._fail(batch, exc)
             did = True
         return did
+
+    def _chaos(self, point: str, seqs) -> None:
+        if self.fault_injector is not None:
+            self.fault_injector(point, seqs)
 
     def run(self, max_steps: int | None = None) -> dict[int, np.ndarray]:
         """Drive :meth:`step` until every submitted request finished (or
@@ -501,13 +681,110 @@ class ServeEngine:
                 break
         return self.results()
 
-    def serve_trace(self, trace, clock=time.perf_counter, sleep=time.sleep) -> dict:
+    # -- graceful drain ------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._drain_reason is not None
+
+    def request_drain(
+        self, reason: str = "drain requested", *,
+        kind: str = "completed", requeue: bool = False,
+    ) -> None:
+        """Begin graceful drain: admission closes (arrivals shed on
+        sight, the waiting queue sheds next step), in-flight requests get
+        ``drain_budget_s`` from now to finish, then shed too. First call
+        wins; later calls are no-ops."""
+        if self._drain_reason is None:
+            self._drain_reason = str(reason)
+            self._drain_kind = kind
+            self._drain_requeue = bool(requeue)
+            self._drain_started = self.clock()
+
+    def _drain_step(self, now: float) -> None:
+        for seq in list(self.scheduler.iter_waiting()):
+            self._finalize(seq, now, "shed")
+        if now - self._drain_started >= self.drain_budget_s:
+            # budget spent: in-flight work sheds, blocks release, the
+            # verdict reports what was cut short
+            for seq in [*self.scheduler.prefilling, *self.scheduler.running]:
+                self._finalize(seq, now, "shed")
+
+    def drain(self, reason: str | None = None, *, kind: str | None = None,
+              requeue: bool | None = None, max_steps: int | None = None) -> dict:
+        """Drain to completion and write the ``requeue.json`` verdict:
+        stop admission, shed the queue, step until in-flight work
+        finishes (or the drain budget sheds it), then record the verdict
+        under ``run_dir`` (skipped when the engine has none) — the same
+        schema every elasticity wrapper reads (doc/elasticity.md).
+        Defaults: a tripped ``PreemptionGuard`` makes this a
+        ``kind="preemption"``, ``requeue=True`` verdict; a manual drain
+        is ``kind="completed"``, no requeue. Returns the verdict dict."""
+        if not self.draining:
+            preempted = self.preemption is not None and self.preemption.triggered
+            if reason is None:
+                reason = (
+                    f"preemption:{self.preemption.signal_name}" if preempted
+                    else "drain requested"
+                )
+            self.request_drain(
+                reason,
+                kind=kind or ("preemption" if preempted else "completed"),
+                requeue=(preempted if requeue is None else requeue),
+            )
+        steps = 0
+        while not self.idle:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        now = self.clock()
+        counts = self.ledger.status_counts()
+        verdict = {
+            "requeue": self._drain_requeue,
+            "kind": self._drain_kind,
+            "reason": self._drain_reason,
+            "serve": {
+                "drain_s": round(now - self._drain_started, 6),
+                "statuses": counts,
+                "drained_clean": self.idle,
+            },
+        }
+        journal.emit("drain", self._drain_started, now, label=self._drain_kind,
+                     **counts)
+        if self.run_dir is not None:
+            from ..checkpoint import write_requeue_verdict
+
+            write_requeue_verdict(
+                self.run_dir, verdict["requeue"], verdict["reason"],
+                verdict["kind"], serve=verdict["serve"],
+            )
+        return verdict
+
+    def leaked_blocks(self) -> int:
+        """Blocks still live once the engine is idle beyond what the
+        prefix tree legitimately holds (one reference per cached node),
+        plus any excess lock references on tree blocks — the chaos
+        drill's zero-leak observable. Only meaningful when :attr:`idle`."""
+        held = self.prefix.stats()["nodes"] if self.prefix is not None else 0
+        leaked = self.pool.num_live - held
+        if self.draft_pool is not None:
+            leaked += self.draft_pool.num_live
+        if self.prefix is not None:
+            leaked += len(self.prefix.leaked_locks())
+        return leaked
+
+    def serve_trace(self, trace, clock=None, sleep=time.sleep) -> dict:
         """Replay a timed request trace in real time: ``trace`` is a list
-        of ``(offset_s, prompt, max_new_tokens[, adapter])`` tuples
-        (offsets relative to the replay start). Requests are submitted
-        when the wall reaches their offset; the engine steps continuously
-        in between. Returns the ledger summary — the bench receipt's
-        engine side."""
+        of ``(offset_s, prompt, max_new_tokens[, adapter_or_kwargs])``
+        tuples (offsets relative to the replay start; the optional last
+        element is an adapter name, or a dict of extra :meth:`submit`
+        keywords — ``tenant``/``deadline_s``/``priority``/sampling).
+        Requests are submitted when the wall reaches their offset; the
+        engine steps continuously in between. ``clock`` defaults to the
+        engine's own (injectable) clock. Returns the ledger summary — the
+        bench receipt's engine side."""
+        if clock is None:
+            clock = self.clock
         pending = sorted(trace, key=lambda e: e[0])
         t0 = clock()
         i = 0
@@ -515,8 +792,14 @@ class ServeEngine:
             now = clock() - t0
             while i < len(pending) and pending[i][0] <= now:
                 off, prompt, max_new, *rest = pending[i]
-                self.submit(prompt, max_new, adapter=rest[0] if rest else None)
+                kw = {}
+                if rest:
+                    kw = dict(rest[0]) if isinstance(rest[0], dict) else {"adapter": rest[0]}
+                self.submit(prompt, max_new, **kw)
                 i += 1
+            if self.draining:
+                # drain: admission is closed — drop the unsubmitted tail
+                i = len(pending)
             if not self.step() and i < len(pending):
                 # idle but the trace has future arrivals: nap until the next
                 sleep(min(max(pending[i][0] - (clock() - t0), 0.0), 0.001))
@@ -604,6 +887,7 @@ class ServeEngine:
         return rows
 
     def _prefill_chunk(self, seq) -> None:
+        self._chaos("prefill", [seq])
         c = self.scheduler.prefill_chunk
         n = min(c, seq.prompt_len - seq.fill)
         # COW-fork before the scatter: an exact full-block prefix match
@@ -642,7 +926,7 @@ class ServeEngine:
         if final:
             # the last real prompt position's logits ARE the first token —
             # time-to-first-token ends here, before any decode step
-            now = time.perf_counter()
+            now = self.clock()
             self.ledger.first_token(seq.req.id, now)
             self.scheduler.prefill_done(seq)
             seq.prev_token = int(seq.req.prompt[-1])
@@ -653,6 +937,7 @@ class ServeEngine:
             self._emit(seq, int(tok[0]), now)
 
     def _decode(self, batch) -> None:
+        self._chaos("decode", batch)
         for s in batch:
             # refcount check before the scatter (DML211): decode writes at
             # fill, past the shared prefix by construction — a fork here
@@ -676,7 +961,7 @@ class ServeEngine:
             self.pool, self.model, self.params, tables, fill, tokens,
             np.zeros(bb, np.int32), ids, row_params,
         )
-        now = time.perf_counter()
+        now = self.clock()
         journal.emit("decode_batch", t0, label=f"b{bb}", active=len(batch),
                      bucket=bb, blocks=nb)
         self.ledger.step_sample(self.scheduler.depth(), len(batch))
@@ -731,15 +1016,21 @@ class ServeEngine:
         last = jnp.asarray(last, jnp.int32)
 
         t0 = journal.now()
-        proposals, dlogits, dpools = self._draft_fn(
-            self.draft_pool.pools, self.draft_params, dtables, fill, prev, last,
-            self._next_rng(), temps, topks, topps,
-            model=self.draft_model, k=k,
-        )
+        try:
+            self._chaos("draft", batch)
+            proposals, dlogits, dpools = self._draft_fn(
+                self.draft_pool.pools, self.draft_params, dtables, fill, prev, last,
+                self._next_rng(), temps, topks, topps,
+                model=self.draft_model, k=k,
+            )
+        except Exception as exc:  # noqa: BLE001 — the draft is an optimization
+            self._degrade_round(batch, t0, bb, exc)
+            return
         self.draft_pool.swap(dpools)
         journal.emit("draft", t0, label=f"b{bb}", active=len(batch),
                      bucket=bb, blocks=nb, k=k)
         t1 = journal.now()
+        self._chaos("verify", batch)
         packed, tpools = self._verify_fn(
             self.pool.pools, self.params, tables, fill, last, proposals, dlogits,
             self._next_rng(), temps, topks, topps, eos, adapters,
@@ -763,6 +1054,20 @@ class ServeEngine:
                     break
                 s.prev_token = prev_last
 
+    def _degrade_round(self, batch, t0: float, bb: int, exc: BaseException) -> None:
+        """A failed DRAFT step degrades the round to plain decode: the
+        draft only ever proposes, so losing it costs proposals (no
+        ``spec_round`` events this round — accept counters stay exact),
+        never correctness or identity. The draft cache misses the
+        degraded token's slot; the next healthy round's 2-token leading
+        rewrite closes one slot and any unwritten remainder only costs
+        accept rate (the same posture as prefix-skipped draft prefill).
+        A failure inside the fallback decode propagates to ``step``'s
+        handler, which fails the batch."""
+        journal.emit("fault", t0, label=f"b{bb}:draft_degrade", active=bb,
+                     error=f"{type(exc).__name__}: {exc}")
+        self._decode(batch)
+
     def _emit(self, seq, tok: int, now: float) -> None:
         seq.out.append(tok)
         self.ledger.token(seq.req.id)
@@ -779,7 +1084,6 @@ class ServeEngine:
                 )[: seq.fill]
                 self.prefix.insert(written, seq.blocks, adapter=seq.adapter_id)
             self.scheduler.finish(seq, now)
-            self.ledger.finished(seq.req.id, now)
-            self._done[seq.req.id] = seq
+            self._record_terminal(seq, now)
         else:
             seq.last_token = tok

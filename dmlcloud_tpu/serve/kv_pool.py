@@ -148,6 +148,25 @@ class KVBlockPool:
             "bytes_total": self.bytes_per_block() * self.num_blocks,
         }
 
+    def assert_consistent(self) -> None:
+        """Audit the host accounting itself: every id in exactly one of
+        {free list, live set}, counts positive, ids in range, and
+        ``free + unique-live == capacity``. Raises ``AssertionError``
+        with the discrepancy — the chaos drill runs this after every
+        injected fault so a corrupted free list can never hide behind a
+        numerically-balanced invariant."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate ids on the free list"
+        live = set(self._ref)
+        assert not (free & live), f"blocks both free and live: {sorted(free & live)}"
+        assert len(free) + len(live) == self.num_blocks, (
+            f"free ({len(free)}) + live ({len(live)}) != capacity ({self.num_blocks})"
+        )
+        bad = [b for b in self._ref if not 0 <= b < self.num_blocks]
+        assert not bad, f"live ids out of range: {bad}"
+        neg = [b for b, c in self._ref.items() if c < 1]
+        assert not neg, f"non-positive refcounts: {neg}"
+
     # -- alloc / retain / release --------------------------------------------
     def alloc(self, n: int) -> list[int]:
         """Hand out ``n`` free blocks, each with ONE reference; raises

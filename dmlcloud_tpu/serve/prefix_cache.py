@@ -243,6 +243,20 @@ class PrefixCache:
         return count
 
     # -- observability -------------------------------------------------------
+    def leaked_locks(self) -> list[int]:
+        """Tree blocks with MORE holders than the tree's own reference —
+        call when no request is live (engine idle): every extra holder is
+        a lock some admission or exit path forgot to release. Empty list
+        = zero lock leaks, the chaos drill's prefix observable."""
+        out = []
+        stack = [c for root in self._roots.values() for c in root.children.values()]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if self.pool.refcount(n.block) > 1:
+                out.append(n.block)
+        return out
+
     def stats(self) -> dict:
         return {
             "nodes": self._nodes,

@@ -50,6 +50,40 @@ reserve their full worst case there (draft prefill skips via the
 target's match length, leaving the skipped draft pages unwritten — the
 verifier guarantees token identity regardless).
 
+Overload control (PR 13) adds the failure half without touching the
+proof above:
+
+- **Terminal statuses.** Every request ends in exactly one of
+  :data:`TERMINAL_STATUSES` — ``ok`` (EOS or token budget), ``cancelled``
+  (explicit :meth:`~dmlcloud_tpu.serve.engine.ServeEngine.cancel`),
+  ``deadline_exceeded`` (its ``deadline_s`` elapsed), ``shed`` (evicted
+  by overload control or drain), or ``error`` (a step failed underneath
+  it). :meth:`Scheduler.terminate` is the ONE exit path: it removes the
+  sequence from whichever queue holds it and releases every resource it
+  owns — target blocks (including locked prefix references and unused
+  COW spares, which live in ``seq.blocks``), draft blocks — so
+  ``free + unique-live == capacity`` holds per pool after ANY exit, at
+  ANY phase. ``finish`` is ``terminate(..., "ok")``.
+- **Bounded admission queue.** ``max_waiting`` caps the waiting queue;
+  an arrival beyond it sheds a victim chosen by ``shed_policy`` —
+  ``"reject"`` sheds the arrival itself, ``"oldest-deadline"`` sheds the
+  lowest-``priority`` request with the earliest deadline (no deadline
+  sorts last; ties shed the arrival — it is cheapest, holding nothing).
+  ``priority`` affects ONLY shed-victim selection, never admission
+  order, so the FIFO starvation-freedom property is untouched.
+- **Per-tenant fairness** (``fairness="tenant"``): deficit round-robin
+  over per-tenant FIFO queues, the classic DRR of Shreedhar & Varghese.
+  Each tenant in the ring accrues ``drr_quantum`` block-credits per
+  visit; the head of the ring serves while its deficit covers the head
+  request's full reservation, then rotates. A head that fits its
+  tenant's deficit but NOT the pool is STICKY — the scheduler stops
+  admitting rather than rotating past it, which is exactly the strict
+  FIFO head-of-line rule applied per ring position, so the
+  starvation-freedom argument survives: every tenant is visited
+  infinitely often, deficits grow unboundedly until served, and the
+  selected head admits as soon as the pool covers it. Within a tenant,
+  order stays strict FIFO.
+
 The scheduler is pure host-side bookkeeping (deques of :class:`_Sequence`
 records); the engine owns every device interaction.
 """
@@ -57,14 +91,19 @@ records); the engine owns every device interaction.
 from __future__ import annotations
 
 import collections
+import itertools
+import math
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Iterator
 
 import numpy as np
 
 from .kv_pool import KVBlockPool
 
-__all__ = ["Request", "Scheduler"]
+__all__ = ["Request", "Scheduler", "TERMINAL_STATUSES"]
+
+#: Every request ends in exactly one of these (engine ``status(rid)``).
+TERMINAL_STATUSES = ("ok", "cancelled", "deadline_exceeded", "shed", "error")
 
 
 @dataclass(eq=False)  # identity comparison: prompt arrays don't define ==
@@ -75,7 +114,10 @@ class Request:
     ``top_p``/``eos_id``) are PER REQUEST — they ride the decode step as
     traced per-row arrays, so one compiled engine serves mixed
     greedy/sampled tenants in a single batch; None inherits the engine's
-    default."""
+    default. ``deadline_s`` is a relative budget from arrival (None =
+    none); ``priority`` orders SHED-VICTIM selection only (lower sheds
+    first); ``tenant`` keys the fairness scheduler (None = the adapter
+    name, or the shared default tenant)."""
 
     prompt: Any
     max_new_tokens: int = 32
@@ -84,6 +126,9 @@ class Request:
     top_k: int | None = None
     top_p: float | None = None
     eos_id: int | None = None
+    deadline_s: float | None = None
+    priority: int = 0
+    tenant: str | None = None
     id: int = -1  # assigned by the engine at submit
 
 
@@ -103,6 +148,12 @@ class _Sequence:
     first_token: float | None = None
     finished: float | None = None
     adapter_id: int = 0
+    # lifecycle: absolute deadline (arrival + deadline_s), fairness tenant,
+    # shed priority, and the terminal status (None while live)
+    deadline: float | None = None
+    tenant: str = ""
+    priority: int = 0
+    status: str | None = None
     # prefix-cache state: leading table entries mapped READ-ONLY from the
     # radix tree (refcount > 1 is the ground truth; this count is the
     # observable), matched tokens, and spare blocks reserved for COW forks
@@ -139,7 +190,11 @@ class Scheduler:
     the per-round speculative overshoot reserved per request (``spec_k``
     for a spec engine, 0 otherwise); ``prefix_cache`` is the engine's
     :class:`~dmlcloud_tpu.serve.prefix_cache.PrefixCache` (None = no
-    sharing — the exact PR-8 accounting)."""
+    sharing — the exact PR-8 accounting). ``max_waiting`` bounds the
+    admission queue (None = unbounded), ``shed_policy`` picks the victim
+    on overflow, ``fairness="tenant"`` switches admission to deficit
+    round-robin over per-tenant FIFO queues with ``drr_quantum``
+    block-credits per ring visit."""
 
     def __init__(
         self,
@@ -150,6 +205,10 @@ class Scheduler:
         draft_pool: KVBlockPool | None = None,
         lookahead: int = 0,
         prefix_cache=None,
+        max_waiting: int | None = None,
+        shed_policy: str = "reject",
+        fairness: str = "fifo",
+        drr_quantum: int | None = None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -157,15 +216,36 @@ class Scheduler:
             raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
         if lookahead < 0:
             raise ValueError(f"lookahead must be >= 0, got {lookahead}")
+        if max_waiting is not None and max_waiting < 1:
+            raise ValueError(f"max_waiting must be >= 1, got {max_waiting}")
+        if shed_policy not in ("reject", "oldest-deadline"):
+            raise ValueError(f"unknown shed_policy {shed_policy!r}")
+        if fairness not in ("fifo", "tenant"):
+            raise ValueError(f"unknown fairness {fairness!r}")
         self.pool = pool
         self.draft_pool = draft_pool
         self.prefix = prefix_cache
         self.lookahead = int(lookahead)
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
+        self.shed_policy = shed_policy
+        self.fairness = fairness
+        self.drr_quantum = int(
+            drr_quantum
+            if drr_quantum is not None
+            else max(1, pool.blocks_for(prefill_chunk))
+        )
+        if self.drr_quantum < 1:
+            raise ValueError(f"drr_quantum must be >= 1, got {drr_quantum}")
         self.waiting: collections.deque[_Sequence] = collections.deque()
         self.prefilling: collections.deque[_Sequence] = collections.deque()
         self.running: list[_Sequence] = []
+        # tenant-fairness state: per-tenant FIFO queues, the DRR ring of
+        # tenants with queued work, and their block-credit deficits
+        self._queues: dict[str, collections.deque[_Sequence]] = {}
+        self._ring: collections.deque[str] = collections.deque()
+        self._deficit: dict[str, float] = {}
 
     # -- queue state ---------------------------------------------------------
     @property
@@ -174,12 +254,27 @@ class Scheduler:
         return len(self.prefilling) + len(self.running)
 
     @property
+    def num_waiting(self) -> int:
+        """Requests queued for admission, across every tenant queue."""
+        if self.fairness == "fifo":
+            return len(self.waiting)
+        return sum(len(q) for q in self._queues.values())
+
+    @property
     def idle(self) -> bool:
-        return not (self.waiting or self.prefilling or self.running)
+        return not (self.num_waiting or self.prefilling or self.running)
 
     def depth(self) -> int:
         """Requests waiting for admission (the queue-depth observable)."""
-        return len(self.waiting)
+        return self.num_waiting
+
+    def iter_waiting(self) -> Iterator[_Sequence]:
+        """Every waiting sequence (ring order across tenant queues)."""
+        if self.fairness == "fifo":
+            return iter(self.waiting)
+        return itertools.chain.from_iterable(
+            self._queues[t] for t in self._ring if t in self._queues
+        )
 
     # -- lifecycle -----------------------------------------------------------
     def reservation(self, seq: _Sequence) -> int:
@@ -190,10 +285,15 @@ class Scheduler:
             seq.prompt_len + seq.req.max_new_tokens + self.lookahead
         )
 
-    def submit(self, seq: _Sequence) -> None:
+    def submit(self, seq: _Sequence) -> list[_Sequence]:
         """Queue a request. Rejects one that could NEVER be admitted —
         a worst case larger than the whole pool would starve the queue
-        behind it forever under strict FIFO."""
+        behind it forever under strict FIFO.
+
+        Returns the sequences SHED by overload control: empty when the
+        queue has room, else the victim ``shed_policy`` chose — possibly
+        ``seq`` itself, which is then never enqueued. The caller owns
+        stamping each victim terminal (:meth:`terminate`)."""
         need = self.reservation(seq)
         pools = [self.pool] + ([self.draft_pool] if self.draft_pool else [])
         for pool in pools:
@@ -204,7 +304,92 @@ class Scheduler:
                 )
         if seq.req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        self.waiting.append(seq)
+        shed: list[_Sequence] = []
+        if self.max_waiting is not None and self.num_waiting >= self.max_waiting:
+            shed.append(self._shed_victim(seq))
+        if seq not in shed:
+            self._enqueue(seq)
+        return shed
+
+    def _shed_victim(self, incoming: _Sequence) -> _Sequence:
+        """Pick the overflow victim. ``reject``: the arrival. ``oldest-
+        deadline``: lowest priority first, then earliest deadline (no
+        deadline = latest); the arrival breaks ties — it holds nothing."""
+        if self.shed_policy == "reject":
+            return incoming
+        return min(
+            [*self.iter_waiting(), incoming],
+            key=lambda s: (
+                s.priority,
+                s.deadline if s.deadline is not None else math.inf,
+                0 if s is incoming else 1,
+            ),
+        )
+
+    def _enqueue(self, seq: _Sequence) -> None:
+        if self.fairness == "fifo":
+            self.waiting.append(seq)
+            return
+        q = self._queues.get(seq.tenant)
+        if q is None:
+            q = self._queues[seq.tenant] = collections.deque()
+        if not q and seq.tenant not in self._ring:
+            self._ring.append(seq.tenant)
+            self._deficit.setdefault(seq.tenant, 0.0)
+        q.append(seq)
+
+    def _discard_waiting(self, seq: _Sequence) -> None:
+        """Forgiving removal from the waiting structures (no-op when the
+        sequence is not queued — e.g. a rejected arrival)."""
+        if self.fairness == "fifo":
+            if seq in self.waiting:
+                self.waiting.remove(seq)
+            return
+        q = self._queues.get(seq.tenant)
+        if q is not None and seq in q:
+            q.remove(seq)
+            if not q:
+                self._retire_tenant(seq.tenant)
+
+    def _retire_tenant(self, tenant: str) -> None:
+        """Drop an emptied tenant queue from the ring; its deficit resets
+        (classic DRR: credit does not accumulate while idle)."""
+        self._queues.pop(tenant, None)
+        self._deficit.pop(tenant, None)
+        if tenant in self._ring:
+            self._ring.remove(tenant)
+
+    def _select_head(self) -> _Sequence | None:
+        """The ONE request admission may consider this step. FIFO: the
+        queue head. Tenant mode: deficit round-robin — visit the ring
+        head; serve it while its deficit covers its head request's full
+        reservation, else grant a quantum and rotate. Terminates because
+        every full ring pass grows every deficit by a quantum."""
+        if self.fairness == "fifo":
+            return self.waiting[0] if self.waiting else None
+        while self._ring:
+            tenant = self._ring[0]
+            q = self._queues.get(tenant)
+            if not q:
+                self._retire_tenant(tenant)
+                continue
+            head = q[0]
+            if self._deficit[tenant] >= self.reservation(head):
+                return head
+            self._deficit[tenant] += self.drr_quantum
+            self._ring.rotate(-1)
+        return None
+
+    def _pop_admitted(self, head: _Sequence) -> None:
+        """Dequeue an admitted head and charge its tenant's deficit."""
+        if self.fairness == "fifo":
+            self.waiting.popleft()
+            return
+        q = self._queues[head.tenant]
+        q.popleft()
+        self._deficit[head.tenant] -= self.reservation(head)
+        if not q:
+            self._retire_tenant(head.tenant)
 
     def admit(self, now: float) -> list[_Sequence]:
         """Admit from the head of the waiting queue while a slot AND the
@@ -222,10 +407,12 @@ class Scheduler:
         shared block WILL be forked). When the discounted need still
         exceeds the free list, LRU leaves are evicted; if that is not
         enough, the locked prefix is released and the head waits — strict
-        FIFO, no leaked references."""
+        FIFO (sticky DRR head in tenant mode), no leaked references."""
         admitted = []
-        while self.waiting and self.active < self.max_slots:
-            head = self.waiting[0]
+        while self.active < self.max_slots:
+            head = self._select_head()
+            if head is None:
+                break
             need = self.reservation(head)
             shared_blocks: list[int] = []
             cached = 0
@@ -244,7 +431,7 @@ class Scheduler:
                 if shared_blocks:
                     self.pool.release(shared_blocks)  # unlock: no leaked refs
                 break  # strict FIFO: nobody may overtake the head
-            self.waiting.popleft()
+            self._pop_admitted(head)
             head.blocks = shared_blocks + self.pool.alloc(need_new)
             head.shared = len(shared_blocks)
             head.cached_tokens = cached
@@ -268,20 +455,54 @@ class Scheduler:
         self.prefilling.remove(seq)
         self.running.append(seq)
 
-    def finish(self, seq: _Sequence, now: float) -> None:
-        """Release a finished sequence's slot and blocks IMMEDIATELY —
-        the no-drain-barrier property lives here (both pools in spec
-        mode: the draft pages recycle with the target's)."""
+    def terminate(self, seq: _Sequence, now: float, status: str) -> bool:
+        """The ONE exit path: remove ``seq`` from whichever queue holds
+        it and release EVERY resource it owns — target blocks (shared
+        prefix references and unused COW spares live in ``seq.blocks``,
+        so one release covers them) and draft blocks — then stamp the
+        terminal ``status``. Idempotent: a second terminate is a no-op
+        returning False, so a cancel racing a deadline (or a fault
+        racing either) can never double-free."""
+        if status not in TERMINAL_STATUSES:
+            raise ValueError(f"unknown terminal status {status!r}")
+        if seq.status is not None:
+            return False
         if seq in self.running:
             self.running.remove(seq)
         elif seq in self.prefilling:
             self.prefilling.remove(seq)
-        self.pool.free(seq.blocks)
+        else:
+            self._discard_waiting(seq)
+        if seq.blocks:
+            self.pool.free(seq.blocks)
         seq.blocks = []
+        seq.shared = 0
+        seq.cow_spare = 0
         if self.draft_pool is not None and seq.draft_blocks:
             self.draft_pool.free(seq.draft_blocks)
         seq.draft_blocks = []
         seq.finished = now
+        seq.status = status
+        return True
+
+    def expire(self, now: float) -> list[_Sequence]:
+        """Terminate every request whose deadline has passed — at ANY
+        phase (queued, mid-prefill, mid-decode); returns the casualties
+        so the engine can record them."""
+        expired = [
+            s
+            for s in [*self.iter_waiting(), *self.prefilling, *self.running]
+            if s.deadline is not None and now >= s.deadline
+        ]
+        for s in expired:
+            self.terminate(s, now, "deadline_exceeded")
+        return expired
+
+    def finish(self, seq: _Sequence, now: float) -> None:
+        """Release a finished sequence's slot and blocks IMMEDIATELY —
+        the no-drain-barrier property lives here (both pools in spec
+        mode: the draft pages recycle with the target's)."""
+        self.terminate(seq, now, "ok")
 
     def decode_batch(self) -> list[_Sequence]:
         """The sequences decoding this step (stable submission order)."""

@@ -75,6 +75,8 @@ SPAN_KINDS = frozenset(
         "decode_batch",  # serving: one continuous-batching decode step
         "draft",  # serving: draft-model device call (spec proposals/prefill)
         "verify",  # serving: one k+1-position spec verification pass
+        "fault",  # serving: a step failure isolated to its request(s)
+        "drain",  # serving: graceful-drain window (request -> verdict)
     }
 )
 

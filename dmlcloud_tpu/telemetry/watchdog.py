@@ -103,6 +103,27 @@ class HangWatchdog:
         self._thread: threading.Thread | None = None
         self._fault_file = None
 
+    # -- serving -------------------------------------------------------------
+    def serve_guard(self, engine) -> "HangWatchdog":
+        """Heartbeat a :class:`~dmlcloud_tpu.serve.engine.ServeEngine`'s
+        loop: the engine calls :meth:`notify` once per ``step``, so a
+        wedged device call (or a scheduler livelock) crosses the stall
+        threshold and dumps forensics like any training hang — and the
+        dump hook additionally requests a graceful DRAIN (``kind="hang"``,
+        requeue) so the engine sheds, releases every block and writes the
+        requeue verdict instead of wedging silently. An existing
+        ``on_dump`` hook is preserved (called first)."""
+        engine.watchdog = self
+        prev = self.on_dump
+
+        def _drain_on_hang(reason: str) -> None:
+            if prev is not None:
+                prev(reason)
+            engine.request_drain(f"hang:{reason}", kind="hang", requeue=True)
+
+        self.on_dump = _drain_on_hang
+        return self
+
     # -- progress ------------------------------------------------------------
     def notify(self) -> None:
         """Mark progress (called on every journal emit and at step/epoch

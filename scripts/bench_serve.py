@@ -18,15 +18,20 @@ engine on a pinned Markov trace with a trained target/draft pair
   engine uncached on the pinned 80%-shared-template trace — warm-template
   p50 TTFT (the near-zero-prefill headline), hit rate, prefill tokens
   saved, token-identity to the uncached engine, 0 mid-run recompiles
+- the CHAOS arm: a hot-tenant deadline burst plus a cold trickle through
+  a bounded admission queue (oldest-deadline shedding, tenant DRR) with
+  ``serve.chaos.ChaosMonkey`` attached — goodput under injected faults,
+  cold-tenant p99 TTFT, zero leaked blocks, every request terminal,
+  fault survivors token-identical to the fault-free reference arm
 
 Thin CLI over ``bench.bench_serve`` (which runs ``bench.py --serve-child``
 CPU-pinned) so the committed receipt and an interactive investigation run
 the exact same workload. The receipt's flat ``gate`` section is what
 ``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares
-(``serve_*``, ``serve_spec_*`` and ``serve_prefix_*`` keys, against EVERY
-committed BENCH_serve_*.json; missing metric = FAIL).
+(``serve_*``, ``serve_spec_*``, ``serve_prefix_*`` and ``serve_chaos_*``
+keys, against EVERY committed BENCH_serve_*.json; missing metric = FAIL).
 
-    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_prefix_pr11.json
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_chaos_pr13.json
 """
 
 import argparse

@@ -1,0 +1,43 @@
+"""DML212 bad fixture: try/except around serve step calls (or terminal-
+status transitions) whose handlers neither free pool blocks nor route the
+request through the lifecycle's exit path — each swallowed failure
+strands a live request with its pages, COW spare and prefix locks still
+allocated, bleeding pool capacity exactly when failures cluster.
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+from dmlcloud_tpu.serve.engine import ServeEngine
+from dmlcloud_tpu.serve.kv_pool import KVBlockPool, PoolExhausted
+
+
+def swallowed_decode_failure(engine, batch):
+    try:
+        engine._decode_batch(batch)
+    except Exception:  # BAD: swallowed — every batch row keeps its blocks forever
+        engine.log.append("decode failed")
+
+
+def logged_prefill_failure(engine, seq, now):
+    try:
+        engine._prefill_chunk(seq, now)
+    except PoolExhausted:  # BAD: logs and moves on; seq stays live, pages held
+        print("pool exhausted", seq.req.id)
+    return seq
+
+
+def half_stamped_terminal(seq, journal, t0):
+    try:
+        seq.status = "error"
+        journal.emit("fault", t0, t0, rid=seq.req.id)
+    except Exception:  # BAD: transition swallowed mid-way, nothing released
+        pass
+
+
+def draft_failure_keeps_draft_blocks(engine, batch):
+    try:
+        proposals = engine._draft_fn(batch)
+    except Exception as exc:  # BAD: neither degrades the round nor errors the rows
+        proposals = None
+        engine.stats["last_draft_error"] = str(exc)
+    return proposals

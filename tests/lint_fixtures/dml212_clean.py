@@ -1,0 +1,48 @@
+"""DML212 clean fixture: serve step failure handlers that route every
+failure into the request lifecycle — releasing pages, stamping the
+terminal status through the one exit path, degrading the round, or
+escalating — plus a try with no step call inside, which is out of scope.
+
+Static lint corpus — never imported or executed. Expected findings: 0.
+"""
+
+from dmlcloud_tpu.serve.engine import ServeEngine
+from dmlcloud_tpu.serve.kv_pool import KVBlockPool, PoolExhausted
+
+
+def failed_rows_terminate(engine, batch):
+    try:
+        engine._decode_batch(batch)
+    except Exception as exc:
+        engine._fail(batch, exc)  # one exit path: blocks, spares, locks freed
+
+
+def prefill_failure_frees(pool, seq, now, engine):
+    try:
+        engine._prefill_chunk(seq, now)
+    except PoolExhausted:
+        pool.free(seq.blocks)  # explicit release sanctions the handler
+
+
+def draft_failure_degrades(engine, batch, t0, bb):
+    try:
+        proposals = engine._draft_fn(batch)
+    except Exception as exc:
+        engine._degrade_round(batch, t0, bb, exc)  # plain decode this round
+        return None
+    return proposals
+
+
+def escalated_failure(engine, batch):
+    try:
+        engine._verify_fn(batch)
+    except Exception:
+        raise  # the caller's handler owns the cleanup
+
+
+def no_step_in_body(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:  # not a step failure: no request mid-flight to release
+        return None

@@ -503,6 +503,106 @@ def test_committed_serve_prefix_receipt_satisfies_the_gate():
         assert key in gate
 
 
+# ---------------------------------------------- serve suite: overload/chaos
+
+SERVE_CHAOS_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "serve_tokens_per_sec_speedup": 3.0,
+        "serve_engine_tokens_per_sec": 300.0,
+        "serve_p99_ttft_s": 1.5,
+        "serve_chaos_goodput_tokens_per_sec": 40.0,
+        "serve_chaos_cold_p99_ttft_s": 1.0,
+        "serve_chaos_zero_leaked_blocks": 1,
+        "serve_chaos_survivor_token_identical": 1,
+        "serve_chaos_all_terminal": 1,
+    },
+}
+
+
+def test_serve_chaos_goodput_regression_fails(tmp_path, capsys):
+    """Goodput under injected faults is the drill's headline throughput:
+    a collapse (the engine stopped finishing ok work under fire) FAILS
+    past tolerance."""
+    doctored = json.loads(json.dumps(SERVE_CHAOS_RECEIPT))
+    doctored["gate"]["serve_chaos_goodput_tokens_per_sec"] = 10.0
+    base = _write(tmp_path, "BENCH_serve_chaos_base.json", SERVE_CHAOS_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "serve_chaos_goodput_tokens_per_sec" in capsys.readouterr().out
+
+
+def test_serve_chaos_cold_ttft_is_lower_is_better(tmp_path, capsys):
+    """The cold tenant's p99 TTFT under the hot-tenant burst is the
+    fairness observable: growth past the wide latency tolerance (DRR
+    stopped protecting the cold tenant) FAILS; shrinking always passes."""
+    slow = json.loads(json.dumps(SERVE_CHAOS_RECEIPT))
+    slow["gate"]["serve_chaos_cold_p99_ttft_s"] = 1.0 * 2.5  # > 2x baseline
+    base = _write(tmp_path, "BENCH_serve_chaos_base.json", SERVE_CHAOS_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "serve_chaos_cold_p99_ttft_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(SERVE_CHAOS_RECEIPT))
+    fast["gate"]["serve_chaos_cold_p99_ttft_s"] = 0.2
+    assert run_gate(base, current=fast) == 0
+
+
+def test_serve_chaos_contracts_are_pass_fail(tmp_path, capsys):
+    """Zero leaked blocks, every request terminal, and survivor token
+    identity ride the gate as 1/0 ints: flipping any is a 100% drop."""
+    for key in (
+        "serve_chaos_zero_leaked_blocks",
+        "serve_chaos_survivor_token_identical",
+        "serve_chaos_all_terminal",
+    ):
+        doctored = json.loads(json.dumps(SERVE_CHAOS_RECEIPT))
+        doctored["gate"][key] = 0
+        base = _write(tmp_path, f"BENCH_serve_{key}.json", SERVE_CHAOS_RECEIPT)
+        assert run_gate(base, current=doctored) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_serve_chaos_missing_metric_fails(tmp_path, capsys):
+    """PR-6 semantics: a chaos metric that silently vanishes from the
+    current run (the drill stopped running) is a FAIL, not a pass."""
+    current = json.loads(json.dumps(SERVE_CHAOS_RECEIPT))
+    del current["gate"]["serve_chaos_zero_leaked_blocks"]
+    base = _write(tmp_path, "BENCH_serve_chaos_base.json", SERVE_CHAOS_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_committed_serve_chaos_receipt_satisfies_the_gate():
+    """The committed PR 13 receipt must pass its own gate and meet the
+    acceptance floors: positive goodput under injected faults, zero
+    leaked blocks, every request terminal, survivors token-identical to
+    the fault-free run — and the drill actually injected something."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_chaos_pr13.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    chaos = receipt["chaos"]
+    assert gate["serve_chaos_goodput_tokens_per_sec"] > 0
+    assert gate["serve_chaos_zero_leaked_blocks"] == 1
+    assert gate["serve_chaos_survivor_token_identical"] == 1
+    assert gate["serve_chaos_all_terminal"] == 1
+    assert gate["serve_chaos_cold_p99_ttft_s"] > 0
+    assert chaos["leaked_blocks"] == 0
+    assert chaos["survivor_token_identical"] is True
+    assert chaos["all_terminal"] is True
+    assert chaos["survivors_ok"] > 0
+    # the drill is real: faults/cancels/sheds actually happened
+    assert chaos["chaos_events"] > 0
+    assert sum(
+        chaos["statuses"].get(k, 0) for k in ("shed", "cancelled", "error")
+    ) > 0
+    # one receipt carries every serve key: the older suites stay enforced
+    for key in ("serve_tokens_per_sec_speedup", "serve_p99_ttft_s",
+                "serve_spec_speedup_vs_engine", "serve_prefix_warm_ttft_s"):
+        assert key in gate
+
+
 def test_committed_elastic_receipt_satisfies_the_gate():
     """The committed PR 7 receipt must pass its own gate and certify exact
     resumption: 0 steps replayed, a resumable preemption verdict."""

@@ -47,6 +47,7 @@ BAD_EXPECT = {
     "DML209": 5,
     "DML210": 4,
     "DML211": 4,
+    "DML212": 4,
     "DML301": 2,
     "DML302": 2,
 }

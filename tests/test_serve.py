@@ -30,10 +30,12 @@ from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 from dmlcloud_tpu.ops.paged_attention import gather_pages, scatter_tokens
 from dmlcloud_tpu.serve import (
     AdapterSet,
+    ChaosMonkey,
     KVBlockPool,
     PoolExhausted,
     PrefixCache,
     ServeEngine,
+    TERMINAL_STATUSES,
 )
 
 
@@ -1247,3 +1249,568 @@ class TestSpecLora:
         np.testing.assert_array_equal(engine.output(r3), ref3)
         assert engine.ledger.records[r2]["cached_tokens"] == 12  # tenant-a warm hit
         assert engine.ledger.records[r3]["cached_tokens"] == 0  # namespaced
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle: cancel / deadlines / terminal statuses (PR 13)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestLifecycle:
+    def test_cancel_queued_and_running_releases_everything(self, tiny_model):
+        """Cancellation at ANY phase: one request cancelled mid-decode,
+        one cancelled while queued — both stamp ``cancelled``, release
+        every block, and the survivor's output is untouched."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=1)
+        r_run = engine.submit(_prompt(5, seed=1), 12)
+        r_ok = engine.submit(_prompt(7, seed=2), 4)
+        r_queued = engine.submit(_prompt(6, seed=3), 4)
+        for _ in range(3):  # r_run admitted + prefilled + a decode step
+            engine.step()
+        assert engine.status(r_run) == "running"
+        assert engine.status(r_queued) == "queued"
+        assert engine.cancel(r_run) and engine.cancel(r_queued)
+        assert engine.status(r_run) == "cancelled"
+        assert engine.status(r_queued) == "cancelled"
+        assert not engine.cancel(r_run)  # idempotent: lost the race, no double-free
+        engine.run(max_steps=2000)
+        assert engine.status(r_ok) == "ok"
+        ref = np.asarray(generate(model, params, jnp.asarray(_prompt(7, seed=2))[None], 4))[0]
+        np.testing.assert_array_equal(engine.output(r_ok), ref)
+        assert engine.pool.num_free == engine.pool.num_blocks
+        with pytest.raises(KeyError):
+            engine.output(r_run) and None  # cancelled work has no output
+        assert not engine.cancel(9999)  # unknown id: False, not a crash
+
+    def test_deadline_expiry_with_fake_clock(self, tiny_model):
+        """A deadline elapsing mid-flight terminates ``deadline_exceeded``
+        and frees the blocks; the deadline-free neighbor is untouched."""
+        model, params = tiny_model
+        t = [0.0]
+        engine = _engine(model, params, max_slots=2, clock=lambda: t[0])
+        r_doomed = engine.submit(_prompt(5, seed=4), 20, deadline_s=1.0)
+        r_ok = engine.submit(_prompt(5, seed=5), 4)
+        for _ in range(3):
+            engine.step()
+        assert engine.status(r_doomed) == "running"
+        t[0] = 2.0  # past the deadline at a mid-decode phase
+        engine.run(max_steps=2000)
+        assert engine.status(r_doomed) == "deadline_exceeded"
+        assert engine.status(r_ok) == "ok"
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.ledger.status_counts() == {"deadline_exceeded": 1, "ok": 1}
+
+    def test_queued_deadline_expires_before_admission(self, tiny_model):
+        """A deadline can expire while the request is still WAITING — it
+        must terminate without ever holding a block."""
+        model, params = tiny_model
+        t = [0.0]
+        engine = _engine(model, params, max_slots=1, clock=lambda: t[0])
+        r_run = engine.submit(_prompt(5, seed=6), 16)
+        r_waiting = engine.submit(_prompt(5, seed=7), 4, deadline_s=0.5)
+        engine.step()
+        assert engine.status(r_waiting) == "queued"
+        t[0] = 1.0
+        engine.step()
+        assert engine.status(r_waiting) == "deadline_exceeded"
+        engine.run(max_steps=2000)
+        assert engine.status(r_run) == "ok"
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_submit_validates_deadline(self, tiny_model):
+        model, params = tiny_model
+        engine = _engine(model, params)
+        with pytest.raises(ValueError, match="deadline_s"):
+            engine.submit(_prompt(4), 4, deadline_s=0.0)
+
+    def test_random_cancel_and_expiry_property(self, tiny_model):
+        """The lifecycle property test: random cancels (seeded monkey) and
+        random deadlines injected over random load — every request ends
+        TERMINAL, ``free + unique-live == capacity`` holds in the pool
+        after every step (the monkey audits it), nothing leaks."""
+        model, params = tiny_model
+        rs = np.random.RandomState(23)
+        engine = ServeEngine(
+            model, params, num_blocks=32, block_size=4, max_slots=3, prefill_chunk=8
+        )
+        monkey = ChaosMonkey(seed=29, p_cancel=0.2, p_stall=0.3, stall_s=0.02)
+        monkey.attach(engine)
+        rids = []
+        for i in range(14):
+            kw = {}
+            if rs.random_sample() < 0.5:
+                kw["deadline_s"] = float(rs.uniform(0.01, 5.0))
+            rids.append(
+                engine.submit(_prompt(int(rs.randint(1, 16)), seed=400 + i),
+                              int(rs.randint(1, 8)), **kw)
+            )
+        engine.run(max_steps=3000)
+        monkey.detach()
+        statuses = [engine.status(r) for r in rids]
+        assert all(s in TERMINAL_STATUSES for s in statuses), statuses
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.leaked_blocks() == 0
+        # ok requests really produced their full budget
+        for rid, s in zip(rids, statuses):
+            if s == "ok":
+                assert len(engine.output(rid)) == engine._all[rid].req.max_new_tokens
+
+
+# ---------------------------------------------------------------------------
+# overload control: bounded queue, shedding, per-tenant fairness (PR 13)
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadControl:
+    def test_bounded_queue_reject_policy_sheds_arrivals(self, tiny_model):
+        """``shed_policy="reject"``: once ``max_waiting`` is reached the
+        ARRIVAL sheds on sight; earlier queued work is untouched."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=1, max_waiting=2)
+        r_run = engine.submit(_prompt(5, seed=10), 10)
+        engine.step()  # r_run leaves the queue for its slot
+        kept = [engine.submit(_prompt(4, seed=11 + i), 3) for i in range(2)]
+        shed = [engine.submit(_prompt(4, seed=13 + i), 3) for i in range(2)]
+        assert [engine.status(r) for r in shed] == ["shed", "shed"]
+        engine.run(max_steps=2000)
+        assert engine.status(r_run) == "ok"
+        assert [engine.status(r) for r in kept] == ["ok", "ok"]
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_oldest_deadline_policy_sheds_doomed_victim(self, tiny_model):
+        """``shed_policy="oldest-deadline"``: overflow sheds the waiting
+        request with the EARLIEST deadline (most doomed) — the arrival
+        wins its seat; lower priority sheds before any deadline compare."""
+        model, params = tiny_model
+        engine = _engine(
+            model, params, max_slots=1, max_waiting=1, shed_policy="oldest-deadline"
+        )
+        engine.submit(_prompt(5, seed=20), 10)
+        engine.step()
+        r_doomed = engine.submit(_prompt(4, seed=21), 3, deadline_s=0.5)
+        r_late = engine.submit(_prompt(4, seed=22), 3, deadline_s=60.0)
+        assert engine.status(r_doomed) == "shed"  # earliest deadline lost
+        assert engine.status(r_late) == "queued"
+        r_low = engine.submit(_prompt(4, seed=23), 3, priority=-1, deadline_s=0.1)
+        assert engine.status(r_low) == "shed"  # priority trumps deadline
+        assert engine.status(r_late) == "queued"
+        engine.run(max_steps=2000)
+        assert engine.status(r_late) == "ok"
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_tenant_fairness_interleaves_cold_tenant(self, tiny_model):
+        """``fairness="tenant"``: a hot tenant's 8-deep backlog does not
+        make a late cold tenant wait behind ALL of it — deficit
+        round-robin admits cold work before the hot queue drains, and
+        nobody starves."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=2, fairness="tenant")
+        hot = [engine.submit(_prompt(5, seed=30 + i), 3, tenant="hot") for i in range(8)]
+        cold = [engine.submit(_prompt(5, seed=40 + i), 3, tenant="cold") for i in range(2)]
+        engine.run(max_steps=3000)
+        assert all(engine.status(r) == "ok" for r in hot + cold)
+        admitted = {r: engine.ledger.records[r]["admitted"] for r in hot + cold}
+        order = sorted(admitted, key=admitted.get)
+        # every cold request beats at least the hot tail to admission
+        for rc in cold:
+            assert order.index(rc) < order.index(hot[-1])
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_priority_never_reorders_fifo_admission(self, tiny_model):
+        """Priority is SHED-VICTIM metadata only: with no overload, the
+        PR-8 strict-FIFO admission contract holds regardless of
+        priorities."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=2)
+        rids = [
+            engine.submit(_prompt(4, seed=50 + i), 2, priority=int(p))
+            for i, p in enumerate([5, -3, 9, 0, -7, 2])
+        ]
+        engine.run(max_steps=2000)
+        admits = [engine.ledger.records[r]["admitted"] for r in rids]
+        assert admits == sorted(admits)
+        assert all(engine.status(r) == "ok" for r in rids)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: seeded fault injection over the full engine (PR 13)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_specs(rs, n):
+    return [(int(rs.randint(1, 16)), int(rs.randint(1, 8))) for _ in range(n)]
+
+
+class TestChaosDrill:
+    def test_seeded_drill_holds_every_contract(self, tiny_model):
+        """THE acceptance drill: a seeded injector (step faults, pool
+        squats, random cancels) over random load on a prefix-cache engine
+        — every request terminal, both pools audited every step, zero
+        prefix lock leaks, zero leaked blocks, and every SURVIVOR's
+        greedy output token-identical to the fault-free reference."""
+        model, params = tiny_model
+        rs = np.random.RandomState(31)
+        specs = _chaos_specs(rs, 16)
+        ref = ServeEngine(
+            model, params, num_blocks=48, block_size=4, max_slots=3, prefill_chunk=8
+        )
+        ref_rids = [ref.submit(_prompt(n, seed=500 + i), m) for i, (n, m) in enumerate(specs)]
+        ref_out = ref.run(max_steps=4000)
+        engine = ServeEngine(
+            model, params, num_blocks=48, block_size=4, max_slots=3, prefill_chunk=8,
+            prefix_cache=True,
+        )
+        monkey = ChaosMonkey(
+            seed=37, p_fault=0.08, max_faults=4, p_exhaust=0.15,
+            exhaust_blocks=6, exhaust_steps=2, p_cancel=0.08,
+        )
+        monkey.attach(engine)
+        rids = [engine.submit(_prompt(n, seed=500 + i), m) for i, (n, m) in enumerate(specs)]
+        engine.run(max_steps=4000)
+        monkey.detach()
+        statuses = [engine.status(r) for r in rids]
+        assert all(s in TERMINAL_STATUSES for s in statuses), statuses
+        for pool in (engine.pool,):
+            pool.assert_consistent()
+        assert engine.prefix.leaked_locks() == []
+        assert engine.leaked_blocks() == 0
+        survivors = [(r, rr) for r, rr, s in zip(rids, ref_rids, statuses) if s == "ok"]
+        assert survivors, "drill too hot: no survivors to compare"
+        for r, rr in survivors:
+            np.testing.assert_array_equal(engine.output(r), ref_out[rr])
+
+    def test_drill_is_replayable(self, tiny_model):
+        """Same seed, same trace -> same injected events and same terminal
+        census: the drill is a deterministic regression test, not a fuzzer."""
+        model, params = tiny_model
+        logs, censuses = [], []
+        for _ in range(2):
+            engine = _engine(model, params, max_slots=2, num_blocks=32)
+            monkey = ChaosMonkey(seed=41, p_fault=0.1, max_faults=3, p_cancel=0.1)
+            monkey.attach(engine)
+            for i in range(8):
+                engine.submit(_prompt(4 + (i % 3) * 4, seed=600 + i), 3 + (i % 2))
+            engine.run(max_steps=2000)
+            monkey.detach()
+            logs.append(list(monkey.log))
+            censuses.append(engine.ledger.status_counts())
+        assert logs[0] == logs[1]
+        assert censuses[0] == censuses[1]
+
+    def test_pool_exhaustion_squat_only_stalls(self, tiny_model):
+        """Exhaustion injected through the pool's own alloc is a STALL,
+        not a failure: admission waits the squat out, everyone finishes
+        ``ok``, and the squat never broke the accounting."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=2, num_blocks=24)
+        monkey = ChaosMonkey(seed=43, p_exhaust=0.5, exhaust_blocks=12, exhaust_steps=2)
+        monkey.attach(engine)
+        rids = [engine.submit(_prompt(5, seed=700 + i), 4) for i in range(6)]
+        engine.run(max_steps=3000)
+        monkey.detach()
+        assert all(engine.status(r) == "ok" for r in rids)
+        assert any(kind == "exhaust" for _, kind, _ in monkey.log)
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_step_fault_isolated_to_its_rows(self, tiny_model):
+        """One injected decode fault errors exactly the rows it was
+        advancing; later requests decode normally on the freed blocks."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=1)
+        monkey = ChaosMonkey(seed=47, p_fault=1.0, fault_points=("decode",), max_faults=1)
+        monkey.attach(engine)
+        r_hit = engine.submit(_prompt(5, seed=800), 6)
+        r_ok = engine.submit(_prompt(5, seed=801), 6)
+        engine.run(max_steps=2000)
+        monkey.detach()
+        assert engine.status(r_hit) == "error"
+        assert engine.status(r_ok) == "ok"
+        ref = np.asarray(generate(model, params, jnp.asarray(_prompt(5, seed=801))[None], 6))[0]
+        np.testing.assert_array_equal(engine.output(r_ok), ref)
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# chaos x speculative decoding (PR 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestSpecChaos:
+    def test_draft_fault_degrades_every_round_to_plain_decode(self, tiny_model, tiny_draft):
+        """The draft is an optimization, not a dependency: with EVERY
+        draft call failing, no round drafts a token (accept counters stay
+        exactly zero) yet every request completes token-identical to
+        serial generate."""
+        model, params = tiny_model
+        draft, dparams = tiny_draft
+        engine = _engine(
+            model, params, max_slots=2, spec_k=3, draft_model=draft, draft_params=dparams
+        )
+        monkey = ChaosMonkey(seed=53, p_fault=1.0, fault_points=("draft",))
+        monkey.attach(engine)
+        specs = [(5, 6), (9, 4), (4, 7)]
+        rids = [engine.submit(_prompt(n, seed=900 + i), m) for i, (n, m) in enumerate(specs)]
+        out = engine.run(max_steps=3000)
+        monkey.detach()
+        s = engine.ledger.summary()
+        assert s["drafted_tokens"] == 0 and s["accepted_tokens"] == 0
+        for i, (rid, (n, m)) in enumerate(zip(rids, specs)):
+            assert engine.status(rid) == "ok"
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(n, seed=900 + i))[None], m)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
+
+    def test_draft_fault_once_then_speculation_resumes(self, tiny_model):
+        """After a single degraded round (self-draft engine), later rounds
+        draft again — the accept counters move and output identity holds."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=2, spec_k=2)
+        monkey = ChaosMonkey(seed=59, p_fault=1.0, fault_points=("draft",), max_faults=1)
+        monkey.attach(engine)
+        rids = [engine.submit(_prompt(5 + 2 * i, seed=950 + i), 6) for i in range(3)]
+        out = engine.run(max_steps=3000)
+        monkey.detach()
+        assert monkey.faults == 1
+        s = engine.ledger.summary()
+        assert s["drafted_tokens"] > 0  # speculation resumed after the fault
+        # self-draft: every drafted token the target still needs is accepted;
+        # only end-of-sequence truncation (draft k, need < k) trims the rate
+        assert s["accept_rate"] >= 0.8
+        for i, rid in enumerate(rids):
+            assert engine.status(rid) == "ok"
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(5 + 2 * i, seed=950 + i))[None], 6)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+
+    def test_verify_fault_errors_only_its_batch(self, tiny_model):
+        """A verify failure is a REAL step failure: exactly the rows in
+        that round error; requests outside the batch finish ok and both
+        pools drain clean."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=2, spec_k=2)
+        monkey = ChaosMonkey(seed=61, p_fault=1.0, fault_points=("verify",), max_faults=1)
+        monkey.attach(engine)
+        rids = [engine.submit(_prompt(4, seed=970 + i), 5) for i in range(3)]
+        engine.run(max_steps=3000)
+        monkey.detach()
+        statuses = [engine.status(r) for r in rids]
+        assert statuses.count("error") >= 1  # the faulted round's rows
+        assert statuses.count("ok") == len(rids) - statuses.count("error")
+        for i, rid in enumerate(rids):
+            if statuses[i] == "ok":
+                ref = np.asarray(
+                    generate(model, params, jnp.asarray(_prompt(4, seed=970 + i))[None], 5)
+                )[0]
+                np.testing.assert_array_equal(engine.output(rid), ref)
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + requeue verdict + watchdog heartbeat (PR 13)
+# ---------------------------------------------------------------------------
+
+
+class TestDrainAndVerdict:
+    def test_manual_drain_finishes_running_sheds_queued(self, tiny_model):
+        """Drain contract: admission closes, the waiting queue sheds, the
+        in-flight request finishes inside the budget, the verdict says
+        ``completed`` / no requeue."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=1)
+        r_run = engine.submit(_prompt(5, seed=70), 4)
+        queued = [engine.submit(_prompt(4, seed=71 + i), 3) for i in range(2)]
+        engine.step()
+        verdict = engine.drain(max_steps=2000)
+        assert engine.status(r_run) == "ok"
+        assert [engine.status(r) for r in queued] == ["shed", "shed"]
+        assert verdict["kind"] == "completed" and verdict["requeue"] is False
+        assert verdict["serve"]["drained_clean"] is True
+        assert verdict["serve"]["statuses"] == {"ok": 1, "shed": 2}
+        # admission is closed for late arrivals too
+        late = engine.submit(_prompt(4, seed=75), 3)
+        assert engine.status(late) == "shed"
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_drain_budget_sheds_inflight_work(self, tiny_model):
+        """Past ``drain_budget_s`` the drain stops waiting: in-flight
+        requests shed, their blocks release, the verdict reports the cut."""
+        model, params = tiny_model
+        t = [0.0]
+        engine = _engine(
+            model, params, max_slots=1, clock=lambda: t[0], drain_budget_s=1.0
+        )
+        r_long = engine.submit(_prompt(5, seed=80), 30)
+        for _ in range(3):
+            engine.step()
+        assert engine.status(r_long) == "running"
+        engine.request_drain("test shutdown")
+        t[0] = 5.0  # blow the budget
+        verdict = engine.drain(max_steps=100)
+        assert engine.status(r_long) == "shed"
+        assert verdict["serve"]["drained_clean"] is True
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_preemption_guard_drives_requeue_verdict(self, tiny_model, tmp_path):
+        """PR-7 composition: a tripped PreemptionGuard turns the next step
+        into a drain and the verdict into ``kind="preemption"`` /
+        ``requeue=True``, written as ``requeue.json`` under ``run_dir``
+        in the schema every elasticity wrapper reads."""
+        from dmlcloud_tpu.checkpoint import read_requeue_verdict
+        from dmlcloud_tpu.parallel.runtime import PreemptionGuard
+
+        model, params = tiny_model
+        guard = PreemptionGuard()
+        guard.triggered = True  # the documented out-of-band test path
+        guard.signal_name = "SIGTERM"
+        engine = _engine(
+            model, params, max_slots=1, preemption=guard, run_dir=tmp_path
+        )
+        r1 = engine.submit(_prompt(5, seed=90), 4)
+        verdict = engine.drain(max_steps=2000)
+        assert verdict["kind"] == "preemption" and verdict["requeue"] is True
+        assert verdict["reason"] == "preemption:SIGTERM"
+        on_disk = read_requeue_verdict(tmp_path)
+        assert on_disk is not None and on_disk["requeue"] is True
+        assert on_disk["kind"] == "preemption"
+        assert on_disk["serve"]["statuses"] == engine.ledger.status_counts()
+        assert engine.status(r1) in ("ok", "shed")  # terminal either way
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_watchdog_serve_guard_drains_on_hang(self, tiny_model, tmp_path):
+        """The telemetry watchdog heartbeats the serve loop: a stall past
+        the threshold dumps forensics AND requests a ``hang`` drain with
+        requeue, so a wedged engine shuts down clean instead of silently."""
+        from dmlcloud_tpu.telemetry.watchdog import HangWatchdog
+
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=1)
+        wt = [0.0]
+        wd = HangWatchdog(tmp_path, threshold_s=10.0, clock=lambda: wt[0])
+        wd.serve_guard(engine)
+        assert engine.watchdog is wd
+        r1 = engine.submit(_prompt(5, seed=95), 3)
+        engine.step()  # heartbeats: notify() rides every engine step
+        wt[0] = 5.0
+        assert wd.check() is None  # progress is fresh: no dump
+        wt[0] = 100.0
+        assert wd.check() is not None  # stall: forensics + drain request
+        assert engine.draining
+        assert engine._drain_kind == "hang" and engine._drain_requeue is True
+        engine.drain(max_steps=2000)
+        assert engine.status(r1) in ("ok", "shed")
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# ledger bounded retention (PR 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerRetention:
+    def test_bounded_detail_exact_aggregates(self):
+        """With ``max_records``, per-request detail evicts FIFO but every
+        summary aggregate stays EXACT over the full history."""
+        from dmlcloud_tpu.serve.ledger import ServeLedger
+
+        led = ServeLedger(max_records=3)
+        for i in range(10):
+            t = float(i)
+            led.arrived(i, t, tenant="t")
+            led.admitted(i, t + 0.5)
+            led.first_token(i, t + 1.0)
+            for _ in range(4):
+                led.token(i)
+            led.finished(i, t + 3.0, status="ok" if i % 2 == 0 else "error")
+        assert len(led.records) == 3  # detail bounded
+        s = led.summary()
+        assert s["requests"] == 10 and s["completed"] == 10
+        assert s["statuses"] == {"ok": 5, "error": 5}
+        assert s["total_tokens"] == 40
+        assert s["mean_queue_wait_s"] == pytest.approx(0.5)
+        # busy span first arrival (0.0) -> last finish (12.0); goodput
+        # counts only the 5 ok requests' 20 tokens (summary rounds to 0.1)
+        assert s["tokens_per_sec"] == pytest.approx(40 / 12.0, abs=0.05)
+        assert s["goodput_tokens_per_sec"] == pytest.approx(20 / 12.0, abs=0.05)
+
+    def test_live_records_never_evicted(self):
+        from dmlcloud_tpu.serve.ledger import ServeLedger
+
+        led = ServeLedger(max_records=2)
+        for i in range(6):
+            led.arrived(i, float(i))
+        assert len(led.records) == 6  # all live: nothing evictable
+        for i in range(6):
+            led.finished(i, 10.0 + i, status="ok")
+        assert len(led.records) == 2  # now terminal detail evicts FIFO
+        assert set(led.records) == {4, 5}
+        assert led.summary()["requests"] == 6  # aggregate unharmed
+
+    def test_engine_retention_bounds_memory(self, tiny_model):
+        """``ledger_max_records`` + ``max_done`` bound a long-running
+        engine: old terminal requests vanish from the ledger, the output
+        map and the status map, while the census stays exact."""
+        model, params = tiny_model
+        engine = _engine(
+            model, params, max_slots=2, ledger_max_records=3, max_done=3
+        )
+        rids = [engine.submit(_prompt(4, seed=110 + i), 2) for i in range(8)]
+        engine.run(max_steps=2000)
+        assert len(engine.ledger.records) <= 3
+        assert len(engine._all) <= 3
+        assert engine.ledger.status_counts() == {"ok": 8}
+        with pytest.raises(KeyError):
+            engine.status(rids[0])  # evicted detail
+        assert engine.status(rids[-1]) == "ok"  # fresh detail retained
+
+
+# ---------------------------------------------------------------------------
+# failed admits x chaos: the hardened-scheduler property (PR 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestFailedAdmitChaos:
+    def test_failed_admits_interleaved_with_chaos(self, tiny_model):
+        """Submissions that FAIL validation (oversized prompts) interleave
+        with shed arrivals, injected faults and pool squats — failed
+        admits record nothing, everything admitted ends terminal, and the
+        pool accounting survives the whole mess."""
+        model, params = tiny_model
+        rs = np.random.RandomState(67)
+        engine = ServeEngine(
+            model, params, num_blocks=16, block_size=4, max_slots=2,
+            prefill_chunk=8, max_waiting=3, shed_policy="oldest-deadline",
+        )
+        monkey = ChaosMonkey(
+            seed=71, p_fault=0.05, max_faults=2, p_exhaust=0.2,
+            exhaust_blocks=4, exhaust_steps=1, p_cancel=0.1,
+        )
+        monkey.attach(engine)
+        accepted, failed = [], 0
+        for i in range(18):
+            if rs.random_sample() < 0.25:
+                with pytest.raises(ValueError):  # oversized: exceeds max_seq_len
+                    engine.submit(_prompt(50, seed=i), 20)
+                failed += 1
+            else:
+                accepted.append(
+                    engine.submit(_prompt(int(rs.randint(1, 10)), seed=1000 + i),
+                                  int(rs.randint(1, 6)))
+                )
+            for _ in range(int(rs.randint(0, 3))):
+                engine.step()
+        engine.run(max_steps=3000)
+        monkey.detach()
+        assert failed > 0, "property needs failed admits in the mix"
+        assert len(engine._all) == len(accepted)  # failed admits recorded NOTHING
+        assert all(engine.status(r) in TERMINAL_STATUSES for r in accepted)
+        engine.pool.assert_consistent()
+        assert engine.pool.num_free == engine.pool.num_blocks
+        assert engine.leaked_blocks() == 0
+        census = engine.ledger.status_counts()
+        assert sum(census.values()) == len(accepted)

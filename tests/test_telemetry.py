@@ -47,6 +47,8 @@ V1_KINDS = {
     "queue_wait", "prefill", "decode_batch",
     # speculative serving (PR 10): draft-model calls, verification passes
     "draft", "verify",
+    # overload control (PR 13): isolated step failures, graceful drain
+    "fault", "drain",
 }
 
 #: Core fields every v1 record carries, with their types.
