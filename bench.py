@@ -1475,15 +1475,167 @@ def _serve_chaos_section():
     }
 
 
+#: the CPU-smoke multi-replica ROUTER drill config — pinned so receipts
+#: stay comparable. Engine geometry rides _SERVE_CFG; three in-process
+#: replicas sit behind one Router on a Poisson two-tenant trace (a hot
+#: tenant bursting, a cold tenant trickling — the DRR-across-replicas
+#: observable). Mid-trace, once kill_after_done requests are terminal,
+#: replica r2 is KILLED (live requests fail over, its engine reaped);
+#: once drain_after_done are terminal, r1 is DRAINED (queued work
+#: migrates, running work finishes, a requeue verdict is written). The
+#: survivors of all that must be greedy-token-identical to a fault-free
+#: pass, everything must end terminal with zero leaked blocks, and the
+#: p99 TTFT — measured ROUTER-side, so a failover's re-prefill and
+#: backoff are inside the number — is the gated latency.
+#: heartbeat_timeout_s is generous because all replicas step from ONE
+#: host loop here: a slow sibling step must not read as a missed beat.
+_SERVE_ROUTER_CFG = dict(
+    n_replicas=3,
+    hot_requests=12, cold_requests=6,
+    hot_mean_interarrival_s=0.01, cold_start_s=0.05, cold_spacing_s=0.15,
+    prompt_lens=(16, 32, 48), new_tokens=(24, 32),
+    seed=3,
+    kill_after_done=5, kill_replica="r2",
+    drain_after_done=10, drain_replica="r1",
+    heartbeat_timeout_s=5.0, max_retries=3, backoff_base_s=0.01,
+)
+
+
+def _serve_router_trace():
+    """The pinned two-tenant Poisson trace the router drill replays:
+    (offset_s, prompt, max_new, submit-kwargs) per request, offsets
+    ascending."""
+    c, sc = _SERVE_ROUTER_CFG, _SERVE_CFG
+    rs = np.random.RandomState(c["seed"])
+
+    def prompt(i):
+        return rs.randint(
+            0, sc["vocab"], size=c["prompt_lens"][i % len(c["prompt_lens"])]
+        ).astype(np.int32)
+
+    trace = []
+    offsets = np.cumsum(
+        rs.exponential(c["hot_mean_interarrival_s"], c["hot_requests"])
+    )
+    for i in range(c["hot_requests"]):
+        trace.append((
+            float(offsets[i]), prompt(i),
+            c["new_tokens"][i % len(c["new_tokens"])], {"tenant": "hot"},
+        ))
+    for j in range(c["cold_requests"]):
+        trace.append((
+            c["cold_start_s"] + j * c["cold_spacing_s"], prompt(j),
+            c["new_tokens"][j % len(c["new_tokens"])], {"tenant": "cold"},
+        ))
+    trace.sort(key=lambda e: e[0])
+    return trace
+
+
+def _serve_router_section():
+    """The multi-replica front-door drill (ISSUE 15's receipt): three
+    warmed engine replicas behind one Router replay the pinned Poisson
+    two-tenant trace; one replica is killed mid-trace and one drained.
+    Returns the results dict whose numbers feed the ``serve_router_*``
+    gate keys: every request terminal router-wide, zero leaked blocks
+    (killed replica reaped and audited too), survivors token-identical
+    to a fault-free pass, and the router-side p99 TTFTs (all requests,
+    failover included, plus the cold tenant's under the hot burst)."""
+    import tempfile
+
+    from dmlcloud_tpu.checkpoint import read_requeue_verdict
+    from dmlcloud_tpu.serve import Router, ServeEngine, TERMINAL_STATUSES
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c, sc = _SERVE_ROUTER_CFG, _SERVE_CFG
+    model, params = _serve_model()
+    trace = _serve_router_trace()
+    n = len(trace)
+    warm = [(0.0, p, new) for _, p, new, _ in trace]
+
+    # each engine has its OWN jit cache (per-engine TraceGuard budget), so
+    # every replica warms on the full signature set; replica 0's fault-free
+    # warm pass doubles as the reference arm every survivor must reproduce
+    # bit-for-bit (greedy decode is batch-composition-independent)
+    engines = []
+    ref_outs = None
+    for r in range(c["n_replicas"]):
+        eng = ServeEngine(
+            model, params,
+            num_blocks=sc["num_blocks"], block_size=sc["block_size"],
+            max_slots=sc["max_slots"], prefill_chunk=sc["prefill_chunk"],
+        )
+        eng.serve_trace(warm)
+        if ref_outs is None:
+            ref_outs = [eng.output(i) for i in range(n)]
+        eng.ledger = ServeLedger()
+        engines.append(eng)
+
+    run_dir = tempfile.mkdtemp(prefix="bench_router_")
+    router = Router(
+        engines,
+        heartbeat_timeout_s=c["heartbeat_timeout_s"],
+        max_retries=c["max_retries"], backoff_base_s=c["backoff_base_s"],
+        run_dir=run_dir,
+    )
+
+    # the drill's controller: deterministic kill + drain, triggered by
+    # terminal-count thresholds (robust to wall-clock jitter — "mid-trace"
+    # by progress, not by seconds)
+    fired = {"kill": False, "drain": False}
+
+    def controller(point, seqs):
+        if point != "router_step":
+            return
+        done = sum(
+            1 for s in router.statuses().values() if s in TERMINAL_STATUSES
+        )
+        if not fired["kill"] and done >= c["kill_after_done"]:
+            fired["kill"] = True
+            router.kill_replica(c["kill_replica"], reason="bench drill")
+        if not fired["drain"] and done >= c["drain_after_done"]:
+            fired["drain"] = True
+            router.drain_replica(c["drain_replica"], reason="bench drill")
+
+    router.fault_injector = controller
+    summary = router.serve_trace(trace)
+    leaked = router.leaked_blocks()
+
+    statuses = [router.status(rid) for rid in range(n)]
+    all_terminal = all(s in TERMINAL_STATUSES for s in statuses)
+    survivors = [rid for rid, s in enumerate(statuses) if s == "ok"]
+    identical = all(
+        np.array_equal(router.output(rid), ref_outs[rid]) for rid in survivors
+    )
+    all_ttfts = router.ttfts()
+    cold_ttfts = router.ttfts(tenant="cold")
+    p99 = lambda xs: round(float(np.percentile(xs, 99)), 4) if xs else None
+    verdict = read_requeue_verdict(run_dir)
+    return {
+        "config": {k: v for k, v in c.items()},
+        "summary": summary,
+        "kill_fired": fired["kill"],
+        "drain_fired": fired["drain"],
+        "failovers": int(router.failovers),
+        "survivors_ok": len(survivors),
+        "leaked_blocks": int(leaked),
+        "survivor_token_identical": bool(identical),
+        "all_terminal": bool(all_terminal),
+        "failover_p99_ttft_s": p99(all_ttfts),
+        "cold_p99_ttft_s": p99(cold_ttfts),
+        "drain_verdict": (verdict or {}).get("serve"),
+    }
+
+
 def serve_child_main():
     """A/B the continuous-batching engine against serial ``generate()`` on
     the pinned Poisson trace, then the speculative engine against the
     plain engine on the pinned Markov trace, then the prefix-cache engine
     against the uncached engine on the pinned 80%-shared-template trace,
-    then the overload/chaos drill on the adversarial two-tenant trace
-    (CPU-pinned child); prints one marker line of JSON — the source of
-    ``BENCH_serve_*.json`` and of ``bench.py --gate --suite serve``'s
-    current numbers."""
+    then the overload/chaos drill on the adversarial two-tenant trace,
+    then the multi-replica router drill (kill one replica mid-trace,
+    drain another) (CPU-pinned child); prints one marker line of JSON —
+    the source of ``BENCH_serve_*.json`` and of ``bench.py --gate
+    --suite serve``'s current numbers."""
     jax.config.update("jax_platforms", "cpu")
     from dmlcloud_tpu.serve import ServeEngine
     from dmlcloud_tpu.serve.ledger import ServeLedger
@@ -1517,6 +1669,7 @@ def serve_child_main():
     spec = _spec_serve_section()
     prefix = _serve_prefix_section()
     chaos = _serve_chaos_section()
+    router = _serve_router_section()
     results = {
         "config": dict(c),
         "value_source": "cpu_smoke",
@@ -1531,6 +1684,7 @@ def serve_child_main():
         "spec": spec,
         "prefix": prefix,
         "chaos": chaos,
+        "router": router,
         # the flat, schema-stable section the perf gate compares
         "gate": {
             "serve_tokens_per_sec_speedup": speedup,
@@ -1564,6 +1718,19 @@ def serve_child_main():
             "serve_chaos_zero_leaked_blocks": int(chaos["leaked_blocks"] == 0),
             "serve_chaos_survivor_token_identical": int(bool(chaos["survivor_token_identical"])),
             "serve_chaos_all_terminal": int(bool(chaos["all_terminal"])),
+            # multi-replica router drill (ISSUE 15): every request ends in
+            # exactly one terminal status router-wide despite a replica
+            # kill and a replica drain mid-trace, zero leaked blocks
+            # across all replicas (the killed one reaped and audited),
+            # survivors greedy-token-identical to a fault-free pass, and
+            # the router-side p99 TTFTs (failover re-prefill and backoff
+            # inside the number; the cold tenant's under the hot burst)
+            # as lower-is-better latencies
+            "serve_router_all_terminal": int(bool(router["all_terminal"])),
+            "serve_router_zero_leaked_blocks": int(router["leaked_blocks"] == 0),
+            "serve_router_survivor_token_identical": int(bool(router["survivor_token_identical"])),
+            "serve_router_failover_p99_ttft_s": router["failover_p99_ttft_s"],
+            "serve_router_hot_tenant_cold_p99_ttft_s": router["cold_p99_ttft_s"],
         },
     }
     print(_SERVE_MARKER + json.dumps(results), flush=True)
@@ -1831,6 +1998,8 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "serve_spec_p99_ttft_s",
         "serve_prefix_warm_ttft_s",
         "serve_chaos_cold_p99_ttft_s",
+        "serve_router_failover_p99_ttft_s",
+        "serve_router_hot_tenant_cold_p99_ttft_s",
         "data_wait_s",
     }
 )
@@ -1946,9 +2115,10 @@ def gate_main(argv: list) -> int:
     against EVERY committed ``BENCH_serve_*.json`` merged into one
     baseline — each key at its most recently committed value (tokens/s
     speedup vs serial generate, p99 TTFT, the ``serve_spec_*`` composition
-    keys and the ``serve_prefix_*`` sharing keys — warm-template TTFT
-    judged lower-is-better; every receipt's keys stay enforced, so a
-    silently-vanished metric FAILS); the ``data`` suite replays the streaming
+    keys, the ``serve_prefix_*`` sharing keys, the ``serve_chaos_*``
+    robustness keys and the ``serve_router_*`` failover/drain keys —
+    latencies judged lower-is-better; every receipt's keys stay enforced,
+    so a silently-vanished metric FAILS); the ``data`` suite replays the streaming
     packed-vs-pad-to-max A/B against the last committed
     ``BENCH_data_*.json`` (packed tokens/s speedup, padding waste
     reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better

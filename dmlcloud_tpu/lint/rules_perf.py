@@ -34,6 +34,13 @@ contracts that keep them fast checkable on CPU:
           through the lifecycle's exit path — the leak-on-error hazard:
           the swallowed failure strands the request live and its pages
           (COW spare, prefix locks) stay allocated forever
+- DML213  in router-loop code (the multi-replica front door —
+          heartbeats, failover, circuit breakers), an UNBOUNDED blocking
+          receive: ``queue.get()`` / ``Connection.recv()`` /
+          ``Event.wait()`` with no ``timeout=`` — one wedged replica (or
+          an empty queue) parks the loop forever, so heartbeat deadlines
+          are never checked and every replica behind the router looks
+          dead at once
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -71,6 +78,7 @@ __all__ = [
     "check_counter_readback_in_loop",
     "check_unguarded_shared_block_write",
     "check_leaky_failure_handler",
+    "check_unbounded_blocking_receive",
 ]
 
 
@@ -786,3 +794,202 @@ def check_leaky_failure_handler(ctx: ModuleCtx):
                 "round, or re-raise",
                 fn_name,
             )
+
+
+# ------------------------------------------------------------------- DML213
+
+#: identifiers that mark a module as ROUTER-LOOP code — the multi-replica
+#: front door (serve/router.py): heartbeat health detection, failover,
+#: per-replica circuit breakers. Only such modules are in scope: the
+#: router's step loop IS the health detector, so any unbounded block
+#: inside it silently disables failure detection for every replica at
+#: once. Deliberately NOT keyed on bare "replica" — that is sharding
+#: vocabulary all over the training stack (replica groups, per-replica
+#: batch), where a worker thread's blocking get has no heartbeat contract
+#: to violate.
+_ROUTER_LOOP_VOCAB = re.compile(
+    r"(?i)(router|heart_?beat|fail_?over|circuit_?breaker|front_?door"
+    r"|replica_?(kill|stall|drain))"
+)
+
+#: constructor terminal names that TYPE a receiver when its binding is
+#: chased through the dataflow core: ``inbox = queue.Queue()`` types
+#: ``inbox`` queue-like no matter what it is called
+_QUEUE_CTOR = re.compile(r"(?i)^(simple|lifo|priority|joinable)?queue$")
+_EVENT_CTOR = re.compile(r"(?i)^(event|condition)$")
+_CONN_CTOR = re.compile(r"(?i)^pipe$")
+
+#: receiver-identifier fallback for receivers the dataflow core cannot
+#: chase (attributes, parameters): names that read as a queue / event /
+#: pipe endpoint
+_QUEUEISH_NAME = re.compile(r"(?i)((^|_)q(ueue)?s?$|inbox|mailbox|chan(nel)?$|work_?items?$)")
+_EVENTISH_NAME = re.compile(
+    r"(?i)((^|_)ev(ent)?$|(^|_)cond(ition)?$|ready$|done$|stop(ped)?$|shutdown$|quit$)"
+)
+_CONNISH_NAME = re.compile(r"(?i)(conn(ection)?$|pipe$|sock(et)?$)")
+
+
+def _module_is_router_loop(ctx: ModuleCtx) -> bool:
+    """Whether the module's IDENTIFIERS (names, attributes, imports,
+    parameters, keywords — never docstrings or comments) mention the
+    router front-door machinery."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and _ROUTER_LOOP_VOCAB.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _ROUTER_LOOP_VOCAB.search(node.attr):
+            return True
+        if isinstance(node, ast.keyword) and node.arg and _ROUTER_LOOP_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, ast.arg) and _ROUTER_LOOP_VOCAB.search(node.arg):
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            if isinstance(node, ast.ImportFrom) and node.module:
+                names.append(node.module)
+            if any(_ROUTER_LOOP_VOCAB.search(n) for n in names):
+                return True
+    return False
+
+
+def _receiver_kind(ctx: ModuleCtx, call: ast.Call) -> str | None:
+    """Classify the receive call's receiver: ``"queue"`` / ``"event"`` /
+    ``"conn"``, else None (not provably a blocking endpoint — a ``dict``
+    named ``table`` must never fire). A bare name is chased to its
+    binding through the dataflow core first (``pending = queue.Queue();
+    pending.get()`` still fires), then the receiver identifier itself is
+    read as a fallback for attributes and parameters."""
+    recv = call.func.value
+    if isinstance(recv, ast.Name):
+        bound = dataflow.resolve_expr(recv, ctx.scopes_at(call))
+        if isinstance(bound, ast.Call):
+            name = ctx.resolve(bound.func) or ""
+            if not name:
+                f = bound.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else ""
+                )
+            last = name.split(".")[-1]
+            if _QUEUE_CTOR.search(last):
+                return "queue"
+            if _EVENT_CTOR.search(last):
+                return "event"
+            if _CONN_CTOR.search(last):
+                return "conn"
+    ident = recv.attr if isinstance(recv, ast.Attribute) else (
+        recv.id if isinstance(recv, ast.Name) else ""
+    )
+    if not ident:
+        return None
+    if _QUEUEISH_NAME.search(ident):
+        return "queue"
+    if _EVENTISH_NAME.search(ident):
+        return "event"
+    if _CONNISH_NAME.search(ident):
+        return "conn"
+    return None
+
+
+def _receive_is_bounded(call: ast.Call) -> bool:
+    """Whether the receive carries a deadline: ``timeout=`` keyword, the
+    positional timeout slot (``get(block, timeout)`` / ``wait(timeout)``),
+    or — for ``recv``, which HAS no timeout form — nothing (the sanction
+    for a pipe is a ``poll(timeout)`` guard, checked by the caller)."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg is None:  # **kwargs — cannot prove it unbounded
+            return True
+    attr = call.func.attr
+    if attr == "get":
+        return len(call.args) >= 2  # get(block, timeout)
+    if attr == "wait":
+        return len(call.args) >= 1  # wait(timeout)
+    return False  # recv() has no timeout parameter at all
+
+
+def _is_queue_get_form(call: ast.Call) -> bool:
+    """``.get()`` is also the dict/mapping accessor; only the queue
+    SIGNATURE counts: no positional args, or a boolean ``block`` flag
+    first — ``table.get(key)`` / ``cfg.get("x", default)`` never match.
+    Keywords outside the ``block``/``timeout`` pair (e.g. ``default=``)
+    mark a mapping accessor too."""
+    if call.args and not (
+        isinstance(call.args[0], ast.Constant) and isinstance(call.args[0].value, bool)
+    ):
+        return False
+    return all(kw.arg in ("block", "timeout", None) for kw in call.keywords)
+
+
+def _function_polls_receiver(ctx: ModuleCtx, call: ast.Call) -> bool:
+    """Whether the enclosing function guards its ``recv()`` with a
+    ``poll(timeout)`` call — the only bounded form a Connection offers."""
+    scope = ctx.enclosing_function(call) or ctx.tree
+    for n in ast.walk(scope):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "poll"
+            and (n.args or n.keywords)
+        ):
+            return True
+    return False
+
+
+@rule("DML213", "unbounded blocking receive in router-loop code")
+def check_unbounded_blocking_receive(ctx: ModuleCtx):
+    """In router-loop code (the multi-replica front door — heartbeats,
+    failover, circuit breakers), a blocking receive with NO deadline —
+    ``queue.get()``, ``Connection.recv()``, ``Event.wait()`` without
+    ``timeout=`` — parks the loop until the far side speaks. The router's
+    step loop IS the health detector: while it is parked, heartbeat
+    deadlines are never evaluated, breakers never half-open, and one
+    wedged replica makes every replica behind the router look dead at
+    once — the exact single-point-of-failure the front door exists to
+    remove. Bound every receive (``get(timeout=...)`` / ``wait(t)`` in a
+    re-check loop, ``poll(t)`` before ``recv()``) or use the non-blocking
+    form (``get_nowait()``). Flow-aware: a receiver is typed by chasing
+    its binding to the constructor through the dataflow core
+    (``pending = queue.Queue(); pending.get()`` fires no matter the
+    name); ``dict.get(key)`` and other mapping accessors never match
+    (queue signature required); training modules are out of scope — a
+    data-plane worker blocking on its feed has no heartbeat contract to
+    violate."""
+    if not _module_is_router_loop(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "recv", "wait")
+        ):
+            continue
+        if node.func.attr == "get" and not _is_queue_get_form(node):
+            continue
+        kind = _receiver_kind(ctx, node)
+        if kind is None:
+            continue
+        # the attr must match the receiver's protocol: get↔queue,
+        # wait↔event, recv↔conn — a queue has no .wait, an event no .get
+        if (kind, node.func.attr) not in (("queue", "get"), ("event", "wait"), ("conn", "recv")):
+            continue
+        if _receive_is_bounded(node):
+            continue
+        if node.func.attr == "recv" and _function_polls_receiver(ctx, node):
+            continue
+        fn = ctx.enclosing_function(node)
+        what = {
+            "queue": "queue get", "event": "event wait", "conn": "pipe recv"
+        }[kind]
+        remedy = {
+            "queue": "get(timeout=...) in a re-check loop, or get_nowait()",
+            "event": "wait(timeout) in a re-check loop",
+            "conn": "poll(timeout) before recv()",
+        }[kind]
+        yield _f(
+            ctx, "DML213", node,
+            f"unbounded blocking {what} in router-loop code: while the loop "
+            "is parked here, heartbeat deadlines are never checked and "
+            "breakers never half-open — one wedged replica makes them all "
+            f"look dead; bound it ({remedy})",
+            getattr(fn, "name", ""),
+        )

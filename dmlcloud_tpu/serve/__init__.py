@@ -38,7 +38,14 @@ static batch per call; this package turns it into a serving engine:
   is written).
 - :class:`ChaosMonkey` (chaos.py): seeded deterministic fault injection
   — step exceptions, pool-exhaustion squats, slow-clock stalls, random
-  cancels — the drill that proves the above under fire.
+  cancels, and (attached to a router) replica kills and stalls — the
+  drill that proves the above under fire.
+- :class:`Router` (router.py): the multi-replica front door — N engine
+  replicas behind one submit/step surface: heartbeat health detection,
+  at-most-once failover via idempotency tokens (``DuplicateRequest`` is
+  the engine-side guard), per-tenant deficit-round-robin placement with
+  stable prefix-affinity hints (``prefix_keys``), per-replica circuit
+  breakers, and router-coordinated graceful drain of one replica.
 
 Quick start::
 
@@ -55,23 +62,27 @@ See doc/serving.md for the architecture, memory math and bench receipts.
 
 from .adapters import AdapterSet
 from .chaos import ChaosError, ChaosMonkey
-from .engine import ServeEngine
+from .engine import DuplicateRequest, ServeEngine
 from .kv_pool import KVBlockPool, PoolExhausted
 from .ledger import ServeLedger
-from .prefix_cache import PrefixCache, PrefixMatch
+from .prefix_cache import PrefixCache, PrefixMatch, prefix_keys
+from .router import Router
 from .scheduler import Request, Scheduler, TERMINAL_STATUSES
 
 __all__ = [
     "AdapterSet",
     "ChaosError",
     "ChaosMonkey",
+    "DuplicateRequest",
     "KVBlockPool",
     "PoolExhausted",
     "PrefixCache",
     "PrefixMatch",
     "Request",
+    "Router",
     "Scheduler",
     "ServeEngine",
     "ServeLedger",
     "TERMINAL_STATUSES",
+    "prefix_keys",
 ]

@@ -25,6 +25,17 @@ injects the four failures the engine promises to survive:
 - **random cancels** — ``cancel(rid)`` against a random live request at
   a random phase (queued, mid-prefill, mid-decode, mid-spec-round).
 
+Attached to a :class:`~dmlcloud_tpu.serve.router.Router` instead
+(:meth:`ChaosMonkey.attach_router`), the monkey injects REPLICA-level
+events from the same seeded RNG into the same replayable log:
+
+- **replica kills** (``p_replica_kill``) — permanent death of a random
+  live replica; the router must fail its requests over and keep every
+  contract (always leaves at least one replica standing).
+- **replica stalls** (``p_replica_stall``) — a replica misses
+  ``replica_stall_steps`` step calls; the router's heartbeat detector
+  decides whether that was a blip or a death.
+
 Everything draws from ``numpy.random.RandomState(seed)`` in a fixed
 per-step order, so a drill is REPLAYABLE: the same seed over the same
 trace injects the same faults at the same points. The drill's acceptance
@@ -82,6 +93,10 @@ class ChaosMonkey:
         stall_s: float = 0.25,
         p_cancel: float = 0.0,
         verify_pools: bool = True,
+        p_replica_kill: float = 0.0,
+        max_replica_kills: int | None = None,
+        p_replica_stall: float = 0.0,
+        replica_stall_steps: int = 2,
     ):
         self._rng = np.random.RandomState(int(seed))
         self.p_fault = float(p_fault)
@@ -94,8 +109,14 @@ class ChaosMonkey:
         self.stall_s = float(stall_s)
         self.p_cancel = float(p_cancel)
         self.verify_pools = bool(verify_pools)
+        self.p_replica_kill = float(p_replica_kill)
+        self.max_replica_kills = max_replica_kills
+        self.p_replica_stall = float(p_replica_stall)
+        self.replica_stall_steps = int(replica_stall_steps)
         self.engine = None
+        self.router = None
         self.faults = 0
+        self.replica_kills = 0
         self.steps = 0
         #: replayable event log: (step, kind, detail) — the drill's record
         self.log: list[tuple[int, str, str]] = []
@@ -108,7 +129,7 @@ class ChaosMonkey:
     def attach(self, engine) -> "ChaosMonkey":
         """Install on ``engine``: becomes its ``fault_injector`` and wraps
         its clock (stall injection). One engine per monkey."""
-        if self.engine is not None:
+        if self.engine is not None or self.router is not None:
             raise RuntimeError("monkey already attached")
         self.engine = engine
         engine.fault_injector = self
@@ -126,6 +147,26 @@ class ChaosMonkey:
         self.engine.clock = self._base_clock
         self.engine = None
 
+    def attach_router(self, router) -> "ChaosMonkey":
+        """Install on a :class:`~dmlcloud_tpu.serve.router.Router` for the
+        REPLICA-level events (``p_replica_kill`` / ``p_replica_stall``):
+        one seeded draw order per router step, logged into the same
+        replayable event log as the engine-level faults. One router per
+        monkey; a monkey may drive either an engine or a router, not
+        both (two injectors sharing one RNG would entangle their draw
+        sequences)."""
+        if self.router is not None or self.engine is not None:
+            raise RuntimeError("monkey already attached")
+        self.router = router
+        router.fault_injector = self
+        return self
+
+    def detach_router(self) -> None:
+        if self.router is None:
+            return
+        self.router.fault_injector = None
+        self.router = None
+
     def _clock(self) -> float:
         return self._base_clock() + self._offset
 
@@ -135,6 +176,9 @@ class ChaosMonkey:
         points flip one seeded coin and may raise :class:`ChaosError`."""
         if point == "step":
             self._on_step()
+            return
+        if point == "router_step":
+            self._on_router_step()
             return
         if (
             self.p_fault
@@ -169,6 +213,43 @@ class ChaosMonkey:
             eng.pool.assert_consistent()
             if eng.draft_pool is not None:
                 eng.draft_pool.assert_consistent()
+
+    def _on_router_step(self) -> None:
+        """Replica-level events, fixed draw order (kill, then stall) —
+        the same determinism contract as :meth:`_on_step`. A kill always
+        leaves at least one replica standing (a drill with zero survivors
+        proves nothing), and chaos never targets a draining replica (the
+        drain path has its own verdict to keep clean)."""
+        self.steps += 1
+        r = self.router
+        candidates = [
+            name for name, rep in r.replicas.items()
+            if rep.alive and not rep.removed and not rep.draining
+        ]
+        if (
+            self.p_replica_kill
+            and self._rng.random_sample() < self.p_replica_kill
+            and (self.max_replica_kills is None
+                 or self.replica_kills < self.max_replica_kills)
+        ):
+            if len(candidates) > 1:
+                name = candidates[int(self._rng.randint(len(candidates)))]
+                self.replica_kills += 1
+                self.log.append((self.steps, "replica_kill", name))
+                r.kill_replica(name, reason="chaos")
+                candidates.remove(name)
+        if self.p_replica_stall and self._rng.random_sample() < self.p_replica_stall:
+            if candidates:
+                name = candidates[int(self._rng.randint(len(candidates)))]
+                self.log.append(
+                    (self.steps, "replica_stall", f"{name}:{self.replica_stall_steps}")
+                )
+                r.stall_replica(name, self.replica_stall_steps)
+        if self.verify_pools:
+            for rep in r.replicas.values():
+                rep.engine.pool.assert_consistent()
+                if rep.engine.draft_pool is not None:
+                    rep.engine.draft_pool.assert_consistent()
 
     def _grab_squat(self) -> None:
         """Steal free blocks through the pool's own alloc — a legitimate

@@ -119,7 +119,20 @@ from .ledger import ServeLedger
 from .prefix_cache import PrefixCache
 from .scheduler import Request, Scheduler, _Sequence
 
-__all__ = ["ServeEngine"]
+__all__ = ["DuplicateRequest", "ServeEngine"]
+
+
+class DuplicateRequest(ValueError):
+    """``submit`` rejected an idempotency token it has already accepted —
+    the original admission stands. Carries the rid it mapped to, so a
+    retrying caller can re-attach instead of double-admitting."""
+
+    def __init__(self, token: str, rid: int):
+        super().__init__(
+            f"idempotency token {token!r} already admitted as request {rid}"
+        )
+        self.token = token
+        self.rid = int(rid)
 
 
 def _copy_block(pools, src, dst):
@@ -365,6 +378,9 @@ class ServeEngine:
         self._calls = 0
         self._next_id = 0
         self._done: dict[int, _Sequence] = {}
+        # idempotency: accepted caller tokens -> rid (dedup for router
+        # retries after ambiguous failures); evicts with retention
+        self._tokens: dict[str, int] = {}
         # lifecycle state: every known sequence by id (live + retained
         # terminal), terminal ids in finish order (the retention bound),
         # the injectable clock the whole loop reads, and drain/fault knobs
@@ -451,6 +467,7 @@ class ServeEngine:
         deadline_s: float | None = None,
         priority: int = 0,
         tenant: str | None = None,
+        token: str | None = None,
     ) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D int32
         token sequence (no padding — paged rows sit at their own absolute
@@ -466,7 +483,15 @@ class ServeEngine:
         fairness scheduler (default: the adapter name, else one shared
         tenant). Submission can itself shed — the returned id's status
         may already be ``shed`` when the bounded queue chose the arrival
-        as the victim."""
+        as the victim.
+
+        ``token`` is an optional caller-supplied idempotency token: a
+        token the engine has already accepted raises
+        :class:`DuplicateRequest` (carrying the original rid) instead of
+        admitting a second copy — the at-most-once guard a router retry
+        leans on after an AMBIGUOUS failure (did the dead replica's
+        submit land before it died?). Tokens age out with the terminal-
+        record retention (``max_done``)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -487,9 +512,13 @@ class ServeEngine:
             aid = self.adapters.id_of(adapter)
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if token is not None and token in self._tokens:
+            raise DuplicateRequest(token, self._tokens[token])
         now = self.clock()
         rid = self._next_id
         self._next_id += 1
+        if rid in self._all:  # a reused rid would silently clobber bookkeeping
+            raise RuntimeError(f"request id {rid} already exists (corrupt id counter)")
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter,
             temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
@@ -499,7 +528,7 @@ class ServeEngine:
         seq = _Sequence(
             req=req, arrival=now, adapter_id=aid,
             deadline=None if deadline_s is None else now + float(deadline_s),
-            tenant=resolved_tenant, priority=int(priority),
+            tenant=resolved_tenant, priority=int(priority), token=token,
             temperature=self._temperature if temperature is None else float(temperature),
             top_k=self._top_k if top_k is None else int(top_k),
             top_p=self._top_p if top_p is None else float(top_p),
@@ -509,11 +538,15 @@ class ServeEngine:
             # drain contract: admission is closed — arrivals shed on sight
             self.ledger.arrived(rid, now, tenant=resolved_tenant)
             self._all[rid] = seq
+            if token is not None:
+                self._tokens[token] = rid
             self._finalize(seq, now, "shed")
             return rid
         shed = self.scheduler.submit(seq)  # validates; raising records nothing
         self.ledger.arrived(rid, now, tenant=resolved_tenant)
         self._all[rid] = seq
+        if token is not None:
+            self._tokens[token] = rid
         for victim in shed:
             # bounded-queue overflow: the scheduler picked the victim but
             # the engine owns its terminal bookkeeping (it may be ``seq``
@@ -578,7 +611,9 @@ class ServeEngine:
             while len(self._terminal) > self._max_done:
                 old = self._terminal.popleft()
                 self._done.pop(old, None)
-                self._all.pop(old, None)
+                dropped = self._all.pop(old, None)
+                if dropped is not None and dropped.token is not None:
+                    self._tokens.pop(dropped.token, None)
 
     def _fail(self, seqs, exc: BaseException) -> None:
         """Isolate a step failure to the request(s) it was advancing:

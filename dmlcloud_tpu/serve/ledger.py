@@ -83,8 +83,13 @@ class ServeLedger:
         self._batch_size_sum = 0
         self._status_counts: dict[str, int] = {}
         window = None if max_records is None else _WINDOW
+        self._window = window
         self._ttfts: collections.deque[float] = collections.deque(maxlen=window)
         self._waits: collections.deque[float] = collections.deque(maxlen=window)
+        # per-tenant TTFT windows: same event-time windowing as _ttfts, so
+        # the per-tenant percentiles in summary() survive record eviction
+        # (the router's fairness receipt reads these)
+        self._tenant_ttfts: dict[str, collections.deque] = {}
         self._agg = {
             "requests": 0, "completed": 0, "tokens": 0, "ok_tokens": 0,
             "drafted": 0, "accepted": 0, "rate_sum": 0.0, "rate_n": 0,
@@ -112,6 +117,14 @@ class ServeLedger:
         rec = self.records[rid]
         rec["first_token"] = now
         self._ttfts.append(now - rec["arrival"])
+        tenant = rec.get("tenant")
+        if tenant is not None:
+            dq = self._tenant_ttfts.get(tenant)
+            if dq is None:
+                dq = self._tenant_ttfts[tenant] = collections.deque(
+                    maxlen=self._window
+                )
+            dq.append(now - rec["arrival"])
 
     def token(self, rid: int) -> None:
         self.records[rid]["tokens"] += 1
@@ -263,6 +276,18 @@ class ServeLedger:
             ),
             "p50_ttft_s": _pct(ttft, 50),
             "p99_ttft_s": _pct(ttft, 99),
+            # per-tenant TTFT percentiles over the same windowed samples
+            # (exactly what callers used to re-derive by hand from
+            # ttfts(tenant=), but eviction-proof): the fairness observable
+            # the router receipt gates on
+            "tenant_ttft": {
+                tenant: {
+                    "n": len(dq),
+                    "p50_s": _pct(list(dq), 50),
+                    "p99_s": _pct(list(dq), 99),
+                }
+                for tenant, dq in sorted(self._tenant_ttfts.items())
+            },
             "mean_queue_wait_s": waits_mean,
             "max_queue_depth": self._max_queue_depth,
             "mean_batch_size": (

@@ -56,7 +56,58 @@ import numpy as np
 
 from .kv_pool import KVBlockPool
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["PrefixCache", "PrefixMatch", "content_key", "prefix_keys", "root_key"]
+
+# -- stable content addresses -----------------------------------------------
+#
+# Builtin hash() salts str/bytes per interpreter (PYTHONHASHSEED), so two
+# processes computed DIFFERENT addresses for the same prefix — fine while
+# the tree was private to one engine, fatal the moment replicas exchange
+# affinity hints keyed on the address (serve/router.py). These use the same
+# splitmix64-style counter mix as data/datasets._mix_u64 (MixPipeline's
+# mixing draws): a pure function of the inputs, identical in every process
+# and on every platform.
+
+_M64 = (1 << 64) - 1
+_ROOT_TAG = 0x726F6F74  # b"root": the per-adapter tree anchor
+
+
+def _mix_u64(a: int, b: int) -> int:
+    x = (int(a) * 0x9E3779B97F4A7C15 + (int(b) + 1) * 0xD1B54A32D192ED03) & _M64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _M64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def root_key(adapter: int) -> int:
+    """The content address of an adapter's tree root."""
+    return _mix_u64(_ROOT_TAG, int(adapter))
+
+
+def content_key(parent_key: int, tokens) -> int:
+    """One full block's chained content address: every token id folded
+    into the parent's key, committing to the entire prefix behind it."""
+    k = int(parent_key)
+    for t in tokens:
+        k = _mix_u64(k, int(t))
+    return k
+
+
+def prefix_keys(tokens, block_size: int, adapter: int = 0) -> list[int]:
+    """The content-address chain of a prompt's full blocks, deepest last —
+    computable WITHOUT a cache instance, which is how the router derives
+    prefix-affinity hints (the deepest key names the warmest replica) and
+    how two replicas agree on what "the same template" means."""
+    toks = np.asarray(tokens).reshape(-1)
+    bs = int(block_size)
+    keys: list[int] = []
+    k = root_key(adapter)
+    for i in range(0, (toks.size // bs) * bs, bs):
+        k = content_key(k, (int(t) for t in toks[i : i + bs]))
+        keys.append(k)
+    return keys
 
 
 class _Node:
@@ -70,7 +121,8 @@ class _Node:
         self.tokens = tokens
         self.block = block
         #: chained content address: commits to the whole prefix behind it
-        self.key = hash((parent.key if parent is not None else 0, tokens))
+        #: (splitmix64 chain — stable across processes, see content_key)
+        self.key = content_key(parent.key if parent is not None else 0, tokens)
         self.parent = parent
         self.children: dict[tuple, _Node] = {}
         self.tick = 0
@@ -115,7 +167,7 @@ class PrefixCache:
         root = self._roots.get(int(adapter))
         if root is None:
             root = self._roots[int(adapter)] = _Node((), -1, None)
-            root.key = hash(("root", int(adapter)))
+            root.key = root_key(int(adapter))
         return root
 
     def _touch(self, node: _Node) -> None:

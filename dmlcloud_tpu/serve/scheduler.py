@@ -154,6 +154,9 @@ class _Sequence:
     tenant: str = ""
     priority: int = 0
     status: str | None = None
+    # caller-supplied idempotency token (engine dedups on it — a router
+    # retry after an ambiguous failure can never double-admit)
+    token: str | None = None
     # prefix-cache state: leading table entries mapped READ-ONLY from the
     # radix tree (refcount > 1 is the ground truth; this count is the
     # observable), matched tokens, and spare blocks reserved for COW forks

@@ -77,6 +77,9 @@ SPAN_KINDS = frozenset(
         "verify",  # serving: one k+1-position spec verification pass
         "fault",  # serving: a step failure isolated to its request(s)
         "drain",  # serving: graceful-drain window (request -> verdict)
+        "route",  # serving: router placement of one request on a replica
+        "failover",  # serving: resubmission of a request off a dead replica
+        "replica_drain",  # serving: router-coordinated drain of one replica
     }
 )
 

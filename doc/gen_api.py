@@ -53,6 +53,8 @@ MODULES = [
     ("dmlcloud_tpu.serve.engine", "ServeEngine: the continuous-batching serving loop."),
     ("dmlcloud_tpu.serve.adapters", "AdapterSet: multi-tenant LoRA serving, merge-free."),
     ("dmlcloud_tpu.serve.ledger", "Per-request latency ledger (TTFT, queue depth)."),
+    ("dmlcloud_tpu.serve.chaos", "Seeded, replayable fault injection for serving drills."),
+    ("dmlcloud_tpu.serve.router", "Multi-replica front door: health-checked routing, failover, drain."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),
     ("dmlcloud_tpu.data.device", "Host-to-device batch transfer."),

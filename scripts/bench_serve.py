@@ -23,15 +23,22 @@ engine on a pinned Markov trace with a trained target/draft pair
   ``serve.chaos.ChaosMonkey`` attached — goodput under injected faults,
   cold-tenant p99 TTFT, zero leaked blocks, every request terminal,
   fault survivors token-identical to the fault-free reference arm
+- the ROUTER arm: three engine replicas behind one ``serve.Router`` on a
+  Poisson two-tenant trace, one replica KILLED mid-trace (live requests
+  fail over at-most-once) and one DRAINED (queued work migrates, a
+  requeue verdict is written) — every request terminal router-wide, zero
+  leaked blocks across all replicas, survivors token-identical to a
+  fault-free pass, router-side p99 TTFT with failover inside the number
 
 Thin CLI over ``bench.bench_serve`` (which runs ``bench.py --serve-child``
 CPU-pinned) so the committed receipt and an interactive investigation run
 the exact same workload. The receipt's flat ``gate`` section is what
 ``bench.py --gate --suite serve`` / scripts/perf_gate.sh compares
-(``serve_*``, ``serve_spec_*``, ``serve_prefix_*`` and ``serve_chaos_*``
-keys, against EVERY committed BENCH_serve_*.json; missing metric = FAIL).
+(``serve_*``, ``serve_spec_*``, ``serve_prefix_*``, ``serve_chaos_*`` and
+``serve_router_*`` keys, against EVERY committed BENCH_serve_*.json;
+missing metric = FAIL).
 
-    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_chaos_pr13.json
+    JAX_PLATFORMS=cpu python scripts/bench_serve.py --out BENCH_serve_router_pr15.json
 """
 
 import argparse

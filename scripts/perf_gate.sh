@@ -10,11 +10,13 @@
 #            resume (0 replayed steps), save-on-preempt latency,
 #            time-to-resume; a missing metric FAILS
 #   serve    the continuous-batching serving A/B (Poisson trace, engine vs
-#            serial generate, the spec arm, the prefix-cache arm) vs EVERY
+#            serial generate, the spec arm, the prefix-cache arm, the
+#            chaos arm, the multi-replica router drill) vs EVERY
 #            committed BENCH_serve_*.json merged into one baseline (each
 #            key at its most recently committed value) — tokens/s speedup,
 #            p99 TTFT, serve_spec_* accept/speedup keys, serve_prefix_*
-#            warm-TTFT / hit-rate keys (latencies lower-is-better;
+#            warm-TTFT / hit-rate keys, serve_chaos_* robustness keys,
+#            serve_router_* failover/drain keys (latencies lower-is-better;
 #            every receipt's keys stay enforced, missing metric = FAIL)
 #   data     the streaming packed data plane A/B (mix -> pack_stream vs
 #            pad-to-max on the pinned ragged corpus) vs the last committed

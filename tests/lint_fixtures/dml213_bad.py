@@ -1,0 +1,38 @@
+"""DML213 bad fixture: unbounded blocking receives in router-loop code —
+each one parks the front door's step loop with no deadline, so heartbeat
+checks never run, breakers never half-open, and one wedged replica makes
+every replica behind the router look dead at once.
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+import queue
+import threading
+
+from dmlcloud_tpu.serve.router import Router
+
+
+def route_loop(router: Router):
+    inbox = queue.Queue()
+    while router.healthy():
+        req = inbox.get()  # BAD: parks the loop; heartbeats go unchecked
+        router.submit(req)
+
+
+def flow_aware_alias(router: Router):
+    pending = queue.Queue()  # nothing queue-ish about the NAME...
+    req = pending.get(True)  # BAD: ...but the binding types it; block flag, no timeout
+    router.submit(req)
+
+
+def wait_for_failover(router: Router, rid):
+    settled = threading.Event()
+    router.on_failover(rid, settled.set)
+    settled.wait()  # BAD: if the replica never answers, neither does the router
+    return router.status(rid)
+
+
+def replica_heartbeat_reader(conn, router: Router):
+    while True:
+        beat = conn.recv()  # BAD: a dead replica sends nothing, forever
+        router.heartbeat(beat)

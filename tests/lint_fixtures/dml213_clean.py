@@ -1,0 +1,57 @@
+"""DML213 clean fixture: every receive in the router loop carries a
+deadline (``timeout=`` keyword, the positional timeout slot, a
+``poll(timeout)`` guard before ``recv()``) or is non-blocking outright —
+plus the mapping accessors a naive ``.get()`` matcher would confuse with
+a queue.
+
+Static lint corpus — never imported or executed. Expected findings: 0.
+"""
+
+import queue
+import threading
+
+from dmlcloud_tpu.serve.router import Router
+
+
+def route_loop_bounded(router: Router):
+    inbox = queue.Queue()
+    while router.healthy():
+        try:
+            req = inbox.get(timeout=0.1)  # fine: wakes to re-check heartbeats
+        except queue.Empty:
+            continue
+        router.submit(req)
+
+
+def flow_aware_alias_bounded(router: Router):
+    pending = queue.Queue()
+    return pending.get(True, 0.5)  # fine: positional timeout slot
+
+
+def drain_without_parking(router: Router):
+    inbox = queue.Queue()
+    while not router.idle:
+        try:
+            router.submit(inbox.get_nowait())  # fine: never blocks
+        except queue.Empty:
+            break
+
+
+def wait_for_failover_bounded(router: Router, rid, settled: threading.Event):
+    while not settled.wait(0.25):  # fine: re-checks the world each lap
+        if not router.healthy():
+            break
+    return router.status(rid)
+
+
+def replica_heartbeat_reader_guarded(conn, router: Router):
+    while router.healthy():
+        if conn.poll(0.1):  # fine: the only bounded form a pipe offers
+            router.heartbeat(conn.recv())
+
+
+def placement_lookup(router: Router, routes: dict, rid, q: dict):
+    # mapping accessors, not queue receives: first positional is a key
+    rep = routes.get(rid)
+    prev = q.get(rid, None)
+    return rep, prev

@@ -603,6 +603,43 @@ def test_committed_serve_chaos_receipt_satisfies_the_gate():
         assert key in gate
 
 
+def test_committed_serve_router_receipt_satisfies_the_gate():
+    """The committed PR 15 receipt must pass its own gate and meet the
+    acceptance floors: every request terminal ROUTER-wide, zero leaked
+    blocks summed across all replicas (the killed one included),
+    survivors token-identical to the fault-free reference pass — and the
+    drill really did kill one replica mid-trace and drain another."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_router_pr15.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    router = receipt["router"]
+    assert gate["serve_router_all_terminal"] == 1
+    assert gate["serve_router_zero_leaked_blocks"] == 1
+    assert gate["serve_router_survivor_token_identical"] == 1
+    assert gate["serve_router_failover_p99_ttft_s"] > 0
+    assert gate["serve_router_hot_tenant_cold_p99_ttft_s"] > 0
+    assert router["leaked_blocks"] == 0
+    assert router["all_terminal"] is True
+    assert router["survivor_token_identical"] is True
+    assert router["survivors_ok"] > 0
+    # the drill is real: a replica died mid-trace, another drained out,
+    # and live requests actually failed over
+    assert router["kill_fired"] is True
+    assert router["drain_fired"] is True
+    assert router["failovers"] > 0
+    assert router["drain_verdict"]["drained_clean"] is True
+    assert router["drain_verdict"]["replica"] == router["config"]["drain_replica"]
+    # one receipt carries every serve key: the older suites stay enforced
+    for key in ("serve_tokens_per_sec_speedup", "serve_p99_ttft_s",
+                "serve_spec_speedup_vs_engine", "serve_prefix_warm_ttft_s",
+                "serve_chaos_goodput_tokens_per_sec"):
+        assert key in gate
+
+
 def test_committed_elastic_receipt_satisfies_the_gate():
     """The committed PR 7 receipt must pass its own gate and certify exact
     resumption: 0 steps replayed, a resumable preemption verdict."""

@@ -48,6 +48,7 @@ BAD_EXPECT = {
     "DML210": 4,
     "DML211": 4,
     "DML212": 4,
+    "DML213": 4,
     "DML301": 2,
     "DML302": 2,
 }

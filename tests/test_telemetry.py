@@ -49,6 +49,9 @@ V1_KINDS = {
     "draft", "verify",
     # overload control (PR 13): isolated step failures, graceful drain
     "fault", "drain",
+    # multi-replica router (PR 15): placement, dead-replica resubmission,
+    # router-coordinated drain of one replica
+    "route", "failover", "replica_drain",
 }
 
 #: Core fields every v1 record carries, with their types.
