@@ -722,7 +722,7 @@ def kernels_child_main():
     one marker line of JSON — the source of the ``BENCH_kernels_*.json``
     receipts and of ``bench.py --gate``'s "current" kernel ratios."""
     jax.config.update("jax_platforms", "cpu")
-    results: dict = {"errors": []}
+    results: dict = {"errors": [], "host": _host_fingerprint()}
     for name, fn in (("flash_attn", kernel_flash_ab), ("int8_decode", kernel_int8_ab),
                      ("spec_decode", kernel_spec_ab)):
         try:
@@ -910,6 +910,7 @@ def elastic_child_main():
         )
         steps_replayed = final_step - _ELASTIC_EPOCHS * _ELASTIC_N_BATCHES
         results = {
+            "host": _host_fingerprint(),
             "workload": {
                 "n_batches": _ELASTIC_N_BATCHES,
                 "epochs": _ELASTIC_EPOCHS,
@@ -1077,11 +1078,19 @@ _SERVE_SPEC_CFG = dict(
 )
 
 
+_SPEC_SERVE_MODELS_CACHE: list = []
+
+
 def _spec_serve_models():
     """The trained target/draft pair of the speculative serving A/B: both
     models fit the same pinned Markov corpus (fp32 — greedy token-identity
     is exact), so the draft genuinely agrees with the target and the
-    receipt's accept rate is a property of speculation, not luck."""
+    receipt's accept rate is a property of speculation, not luck.
+    Memoized within the child process — the Medusa section reuses the SAME
+    trained target (and pinned trace), so the spec-vs-medusa comparison is
+    paired, not a retrain."""
+    if _SPEC_SERVE_MODELS_CACHE:
+        return _SPEC_SERVE_MODELS_CACHE[0]
     from dmlcloud_tpu.data import markov_tokens
     from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig, lm_loss
 
@@ -1123,7 +1132,8 @@ def _spec_serve_models():
 
     tparams, tloss = train(target, 0)
     dparams, dloss = train(draft, 1)
-    return target, tparams, tloss, draft, dparams, dloss
+    _SPEC_SERVE_MODELS_CACHE.append((target, tparams, tloss, draft, dparams, dloss))
+    return _SPEC_SERVE_MODELS_CACHE[0]
 
 
 def _spec_serve_trace():
@@ -1215,6 +1225,145 @@ def _spec_serve_section():
         "accept_rate": spec["accept_rate"],
         "token_identical_to_serial": bool(identical),
         "mid_run_recompiles": int(recompiles),
+    }
+
+
+def _train_medusa_heads(target, tparams, k, steps=300, lr=2e-3):
+    """Distil ``k - 1`` Medusa heads on the FROZEN trained target: head
+    ``h`` learns to predict the token ``h + 2`` positions ahead from the
+    final hidden state (one target forward per batch, stop-gradient'd —
+    only the tiny head stacks train). Returns ``(heads, final_loss)``."""
+    from dmlcloud_tpu.data import markov_tokens
+    from dmlcloud_tpu.models.speculative import init_medusa_heads, medusa_head_logits
+
+    c = _SERVE_SPEC_CFG
+    n_batches = 8
+    corpus = markov_tokens(c["vocab"], c["train_b"] * n_batches, c["train_s"])
+    batches = [
+        jnp.asarray(corpus[i * c["train_b"]:(i + 1) * c["train_b"]], jnp.int32)
+        for i in range(n_batches)
+    ]
+    heads = init_medusa_heads(
+        target.cfg, k, jax.random.PRNGKey(2),
+        lm_head_kernel=tparams["lm_head"]["kernel"],
+    )
+    tx = optax.adamw(lr)
+    opt = tx.init(heads)
+    d = target.cfg.hidden_dim
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(heads, opt, tokens):
+        hidden = jax.lax.stop_gradient(
+            target.apply({"params": tparams}, tokens, return_hidden=True)
+        )  # [B, S, D] — the SAME tensor the serving step hands the heads
+
+        def loss_fn(heads):
+            b, s, _ = hidden.shape
+            hl = medusa_head_logits(heads, hidden.reshape(-1, d)).reshape(b, s, k - 1, -1)
+            total = 0.0
+            for h in range(k - 1):
+                off = h + 2  # head h proposes the token off positions ahead
+                lg = hl[:, : s - off, h].astype(jnp.float32)
+                lb = tokens[:, off:]
+                total += optax.softmax_cross_entropy_with_integer_labels(lg, lb).mean()
+            return total / (k - 1)
+
+        loss, grads = jax.value_and_grad(loss_fn)(heads)
+        up, new_opt = tx.update(grads, opt, heads)
+        return optax.apply_updates(heads, up), new_opt, loss
+
+    loss = None
+    for i in range(steps):
+        heads, opt, loss = step(heads, opt, batches[i % n_batches])
+    return heads, float(loss)
+
+
+def _serve_medusa_section():
+    """The Medusa-serving A/B (PR 16): the SAME trained target as the spec
+    section, its separate draft model replaced by ``k - 1`` distilled
+    decode heads — no draft model, no draft prefill mirror, no second page
+    pool anywhere — vs the plain engine on the SAME pinned Markov trace.
+    Returns the results dict behind the ``serve_medusa_*`` gate keys."""
+    from dmlcloud_tpu.models.generate import generate
+    from dmlcloud_tpu.serve import ServeEngine
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    c = _SERVE_SPEC_CFG
+    k = c["k"]
+    target, tparams, tloss, _, _, _ = _spec_serve_models()
+    heads, head_loss = _train_medusa_heads(target, tparams, k)
+    trace = _spec_serve_trace()
+
+    serial_outs = [
+        np.asarray(generate(target, tparams, jnp.asarray(p)[None], n))[0]
+        for _, p, n in trace
+    ]
+
+    def run_arm(**extra):
+        eng = ServeEngine(
+            target, tparams, num_blocks=c["num_blocks"], block_size=c["block_size"],
+            max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"], **extra,
+        )
+        eng.serve_trace([(0.0, p, n) for _, p, n in trace])  # warm: compile all
+        warm_outs = [eng.output(i) for i in range(len(trace))]
+        warm_sigs = eng.compiled_signatures()
+        eng.ledger = ServeLedger()
+        summary = eng.serve_trace(trace)
+        return eng, summary, warm_outs, warm_sigs
+
+    base_eng, base, _, _ = run_arm()
+    med_eng, med, med_outs, med_warm_sigs = run_arm(medusa_k=k, medusa_heads=heads)
+    recompiles = med_eng.compiled_signatures() - med_warm_sigs
+    # budget-only spec-mode twin (self-draft, never stepped): the docs'
+    # signature-budget-SHRINKS claim, measured on identical bucket sets
+    spec_twin = ServeEngine(
+        target, tparams, num_blocks=c["num_blocks"], block_size=c["block_size"],
+        max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"], spec_k=k,
+    )
+
+    # the deleted-draft-pool contract, asserted on the live engine: no
+    # second pool exists, and the one pool is clean after the run
+    assert med_eng.draft_pool is None
+    pool_stats = med_eng.pool.stats()
+    assert pool_stats["free"] + pool_stats["live"] == pool_stats["capacity"]
+    leaked = med_eng.leaked_blocks()
+
+    identical = all(
+        np.array_equal(w, s) for w, s in zip(med_outs, serial_outs)
+    )
+    speedup = (
+        round(med["tokens_per_sec"] / base["tokens_per_sec"], 3)
+        if med["tokens_per_sec"] and base["tokens_per_sec"]
+        else None
+    )
+    rnd = lambda d: {
+        k_: (round(v, 4) if isinstance(v, float) else v) for k_, v in d.items()
+    }
+    return {
+        "config": dict(c),
+        "target_loss": round(tloss, 3),
+        "head_distill_loss": round(head_loss, 3),
+        "engine": rnd(base),
+        "medusa_engine": {
+            **rnd(med),
+            "compiled_signatures": med_eng.compiled_signatures(),
+            "max_signatures": med_eng.max_signatures,
+            "target_pool": pool_stats,
+            "draft_pool_blocks": 0,  # structurally: med_eng.draft_pool is None
+            "leaked_blocks": int(leaked),
+        },
+        "speedup_tokens_per_sec": speedup,
+        "accept_rate": med["accept_rate"],
+        "token_identical_to_serial": bool(identical),
+        "mid_run_recompiles": int(recompiles),
+        # the signature-budget delta vs spec mode the docs quote (< 0: no
+        # draft prefill bucket set, no second per-round step)
+        "max_signatures_vs_spec_mode": med_eng.max_signatures - spec_twin.max_signatures,
+        "max_signatures_detail": {
+            "medusa": med_eng.max_signatures,
+            "spec": spec_twin.max_signatures,
+            "plain": base_eng.max_signatures,
+        },
     }
 
 
@@ -1667,12 +1816,14 @@ def serve_child_main():
         else None
     )
     spec = _spec_serve_section()
+    medusa = _serve_medusa_section()
     prefix = _serve_prefix_section()
     chaos = _serve_chaos_section()
     router = _serve_router_section()
     results = {
         "config": dict(c),
         "value_source": "cpu_smoke",
+        "host": _host_fingerprint(),
         "serial": serial,
         "engine": {
             **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in summary.items()},
@@ -1682,6 +1833,7 @@ def serve_child_main():
         "speedup_tokens_per_sec": speedup,
         "token_identical_to_serial": identical,
         "spec": spec,
+        "medusa": medusa,
         "prefix": prefix,
         "chaos": chaos,
         "router": router,
@@ -1699,6 +1851,21 @@ def serve_child_main():
             "serve_spec_p99_ttft_s": spec["spec_engine"]["p99_ttft_s"],
             "serve_spec_token_identical": int(bool(spec["token_identical_to_serial"])),
             "serve_spec_zero_recompiles": int(spec["mid_run_recompiles"] == 0),
+            # Medusa decoding (PR 16): the draftless speculative mode —
+            # throughput at least the plain engine's, accept rate of the
+            # distilled heads, greedy token-identity, zero mid-run
+            # recompiles, and the deleted-draft-pool contract (no second
+            # pool allocated, pool clean after the run) as pass/fail ints
+            "serve_medusa_speedup_vs_engine": medusa["speedup_tokens_per_sec"],
+            "serve_medusa_accept_rate": medusa["accept_rate"],
+            "serve_medusa_tokens_per_sec": medusa["medusa_engine"]["tokens_per_sec"],
+            "serve_medusa_p99_ttft_s": medusa["medusa_engine"]["p99_ttft_s"],
+            "serve_medusa_token_identical": int(bool(medusa["token_identical_to_serial"])),
+            "serve_medusa_zero_recompiles": int(medusa["mid_run_recompiles"] == 0),
+            "serve_medusa_zero_draft_blocks": int(
+                medusa["medusa_engine"]["draft_pool_blocks"] == 0
+                and medusa["medusa_engine"]["leaked_blocks"] == 0
+            ),
             # prefix-cache sharing (ISSUE 11): warm-template TTFT as a
             # lower-is-better latency, hit rate + prefill-skip fraction as
             # ratios, token-identity-to-uncached and the
@@ -1935,6 +2102,7 @@ def data_child_main():
             "native_packer": native_pack.available(),
         },
         "value_source": "cpu_smoke",
+        "host": _host_fingerprint(),
         "pad_to_max": pad,
         "packed_stream": packed,
         "packed_vs_pad_tokens_per_sec": speedup,
@@ -1977,6 +2145,147 @@ def bench_data(timeout_s: int = 900) -> dict | None:
     return None
 
 
+# ------------------------------------------------ quantized-training bench
+
+_TRAIN_QUANT_MARKER = "TRAIN_QUANT_BENCH_RESULTS "
+
+#: the CPU-smoke quantized-training A/B config — pinned so the
+#: ``BENCH_train_quant_*.json`` receipts stay comparable across commits.
+#: Shapes are sized so the projection GEMMs dominate the step on one CPU
+#: core (the convert-per-GEMM tax of the emulated-bf16 arm, and the int8
+#: arm's avoidance of it, is what the A/B measures — doc/performance.md).
+_TRAIN_QUANT_CFG = dict(
+    vocab=512, layers=3, heads=8, kv=4, head_dim=32, hidden=256, mlp=1024,
+    max_seq_len=128, batch=8, seq=96, lr=1e-3, batches_per_epoch=6,
+    epochs=4, seed=0,
+    # int8 trains fp32 master weights; its trajectory must track the bf16
+    # baseline's to within this relative gap on the final epoch's mean loss
+    loss_rel_bound=0.05,
+)
+
+
+def _train_quant_arm(precision: str, dtype):
+    """One training arm of the quantized-training A/B: the pinned tiny LM
+    driven through the REAL ``TrainValStage`` (``precision=`` is the
+    production switch being benchmarked, not a bench-local reimplementation)
+    on the pinned corpus. Epoch 0 pays compilation; steps/s comes from the
+    remaining epochs' wall time. Returns (steps_per_sec, per-epoch mean
+    train losses)."""
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    c = _TRAIN_QUANT_CFG
+    cfg = TransformerConfig(
+        vocab_size=c["vocab"], num_layers=c["layers"], num_heads=c["heads"],
+        num_kv_heads=c["kv"], head_dim=c["head_dim"], hidden_dim=c["hidden"],
+        mlp_dim=c["mlp"], max_seq_len=c["max_seq_len"], dtype=dtype,
+    )
+    rng = np.random.RandomState(c["seed"])
+    train = [
+        {"tokens": rng.randint(0, c["vocab"], size=(c["batch"], c["seq"])).astype(np.int32)}
+        for _ in range(c["batches_per_epoch"])
+    ]
+    val = [dict(train[0])]
+    epoch_times: list = []
+
+    class QuantBenchStage(dml.TrainValStage):
+        def pre_stage(self):
+            model = DecoderLM(cfg)
+            params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+            self.pipeline.register_model("lm", model, params=params, verbose=False)
+            self.pipeline.register_optimizer("adamw", optax.adamw(c["lr"]))
+            self.pipeline.register_dataset("train", train, verbose=False)
+            self.pipeline.register_dataset("val", val, verbose=False)
+
+        def pre_epoch(self):
+            self._t0 = time.perf_counter()
+
+        def post_epoch(self):
+            epoch_times.append(time.perf_counter() - self._t0)
+
+        def step(self, state, batch):
+            toks = batch["tokens"]
+            logits = state.apply_fn({"params": state.params}, toks[:, :-1])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), toks[:, 1:]
+            ).mean()
+            return loss
+
+    pipe = dml.TrainingPipeline(name=f"quant-bench-{precision}")
+    stage = QuantBenchStage(precision=precision)
+    pipe.append_stage(stage, max_epochs=c["epochs"])
+    pipe.run()
+    losses = [float(x) for x in stage.tracker["train/loss"]]
+    timed = epoch_times[1:]  # epoch 0 pays jit compilation
+    steps_per_sec = c["batches_per_epoch"] * len(timed) / sum(timed)
+    return steps_per_sec, losses
+
+
+def train_quant_child_main():
+    """A/B the quantized training path (``TrainValStage(precision="int8")``
+    over fp32 master weights, models/quant.py) against the plain bf16 stage
+    on the pinned tiny-LM config (CPU-pinned child); prints one marker line
+    of JSON — the source of the ``BENCH_train_quant_*.json`` receipts. The
+    int8 arm must be FASTER than bf16 (XLA:CPU emulates bf16 GEMMs with a
+    widen/round pass the int8 path never takes; on TPU the win is the int8
+    MXU path) and its loss trajectory must track bf16's."""
+    jax.config.update("jax_platforms", "cpu")
+    c = _TRAIN_QUANT_CFG
+    bf16_sps, bf16_losses = _train_quant_arm("full", jnp.bfloat16)
+    int8_sps, int8_losses = _train_quant_arm("int8", jnp.float32)
+    tokens_per_step = c["batch"] * (c["seq"] - 1)
+    loss_rel_gap = abs(int8_losses[-1] - bf16_losses[-1]) / max(abs(bf16_losses[-1]), 1e-9)
+    trajectory_ok = loss_rel_gap <= c["loss_rel_bound"]
+    results = {
+        "config": dict(c),
+        "value_source": "cpu_smoke",
+        "host": _host_fingerprint(),
+        "bf16": {
+            "steps_per_sec": round(bf16_sps, 4),
+            "tokens_per_sec": round(bf16_sps * tokens_per_step, 1),
+            "epoch_losses": [round(x, 5) for x in bf16_losses],
+        },
+        "int8": {
+            "steps_per_sec": round(int8_sps, 4),
+            "tokens_per_sec": round(int8_sps * tokens_per_step, 1),
+            "epoch_losses": [round(x, 5) for x in int8_losses],
+        },
+        "loss_rel_gap_final_epoch": round(loss_rel_gap, 5),
+        # the flat, schema-stable section the perf gate compares
+        "gate": {
+            "train_int8_speedup_vs_bf16": round(int8_sps / bf16_sps, 3),
+            "train_int8_steps_per_sec": round(int8_sps, 3),
+            "train_int8_tokens_per_sec": round(int8_sps * tokens_per_step, 1),
+            "train_int8_loss_trajectory_ok": int(trajectory_ok),
+        },
+    }
+    print(_TRAIN_QUANT_MARKER + json.dumps(results), flush=True)
+
+
+def bench_train_quant(timeout_s: int = 1200) -> dict | None:
+    """Run the quantized-training A/B in a CPU-pinned child; returns its
+    results dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--train-quant-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_TRAIN_QUANT_MARKER):
+            try:
+                return json.loads(line[len(_TRAIN_QUANT_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 # --------------------------------------------------------------- perf gate
 
 #: relative drop in a gate metric that fails the gate (15%: comfortably
@@ -1996,11 +2305,13 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "elastic_time_to_resume_s",
         "serve_p99_ttft_s",
         "serve_spec_p99_ttft_s",
+        "serve_medusa_p99_ttft_s",
         "serve_prefix_warm_ttft_s",
         "serve_chaos_cold_p99_ttft_s",
         "serve_router_failover_p99_ttft_s",
         "serve_router_hot_tenant_cold_p99_ttft_s",
         "data_wait_s",
+        "tier1_suite_wall_s",
     }
 )
 
@@ -2009,6 +2320,54 @@ _GATE_LOWER_IS_BETTER = frozenset(
 #: ratios; the gate exists to catch the async save turning sync or the
 #: resume path re-running whole epochs — order-of-magnitude breakage)
 _GATE_LATENCY_TOLERANCE = 1.0
+
+
+def _host_fingerprint() -> dict:
+    """Where a receipt's numbers were measured: CPU count, platform string,
+    python version. Stamped into every bench child's receipt so the gate can
+    WARN (not fail) when an ABSOLUTE baseline key — a tokens/s or a latency,
+    as opposed to a within-run ratio — was committed on a different box and
+    its floor may simply not transfer."""
+    import platform as _platform
+
+    return {
+        "cpu_count": os.cpu_count(),
+        "platform": _platform.platform(),
+        "python": _platform.python_version(),
+    }
+
+
+#: gate keys whose baseline value is an ABSOLUTE measurement of the box it
+#: ran on (throughputs, latencies, wall times) rather than a within-run
+#: ratio — the ones the cross-host warning below is about
+def _absolute_gate_keys(metrics: dict) -> list:
+    return [
+        k for k in metrics
+        if k.endswith(("_per_sec", "_s")) and k not in ("tokens_per_sec_speedup",)
+    ]
+
+
+def _warn_if_cross_host(receipt: dict, name: str) -> None:
+    """Print a stderr warning when ``receipt`` carries a host fingerprint
+    that does not match this box and contributes absolute (non-ratio) gate
+    keys. Old receipts without a fingerprint stay silent — nothing to
+    compare."""
+    host = receipt.get("host")
+    if not isinstance(host, dict):
+        return
+    here = _host_fingerprint()
+    if host == here:
+        return
+    abs_keys = _absolute_gate_keys(_gate_metrics(receipt))
+    if not abs_keys:
+        return
+    print(
+        f"gate: WARNING — baseline {name} was recorded on a different host "
+        f"({host.get('platform')}, {host.get('cpu_count')} cpus; this box: "
+        f"{here.get('platform')}, {here.get('cpu_count')} cpus); its absolute "
+        f"floors may not transfer: {', '.join(sorted(abs_keys))}",
+        file=sys.stderr,
+    )
 
 
 def _gate_metrics(receipt: dict) -> dict:
@@ -2060,6 +2419,7 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
         with open(baseline_path) as f:
             baseline = json.load(f)
         baseline_name = os.path.basename(baseline_path)
+        _warn_if_cross_host(baseline, baseline_name)
     if isinstance(current, str):
         with open(current) as f:
             current = json.load(f)
@@ -2104,11 +2464,14 @@ def run_gate(baseline_path: str, current: dict | str | None = None,
 
 
 def gate_main(argv: list) -> int:
-    """``bench.py --gate [--suite kernels|elastic|serve|data|all]
+    """``bench.py --gate [--suite kernels|elastic|serve|data|tier1|all]
     [--baseline B.json] [--current C.json] [--tolerance 0.15]`` — CI
     regression gate over the committed receipts (scripts/perf_gate.sh
     wires it into the lint-gate flow). The ``kernels`` suite (default)
-    measures the kernel A/Bs; the ``elastic`` suite runs the preemption
+    measures the kernel A/Bs AND the quantized-training A/B against every
+    committed ``BENCH_kernels_*.json`` + ``BENCH_train_*.json`` merged into
+    one baseline (the ``train_int8_*`` speedup/trajectory keys stay
+    enforced; a vanished metric FAILS); the ``elastic`` suite runs the preemption
     drill and compares its metrics against the last committed
     ``BENCH_elastic_*.json`` (exact resume, save-on-preempt latency,
     time-to-resume); the ``serve`` suite replays the Poisson serving A/B
@@ -2122,8 +2485,11 @@ def gate_main(argv: list) -> int:
     packed-vs-pad-to-max A/B against the last committed
     ``BENCH_data_*.json`` (packed tokens/s speedup, padding waste
     reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better
-    latency). A missing metric FAILS in every suite; ``all`` chains them
-    and fails on the worst."""
+    latency); the ``tier1`` suite (opt-in, not part of ``all``) times the
+    tier-1 pytest run and gates its wall seconds lower-is-better against
+    the last ``BENCH_tier1_*.json``. A missing metric FAILS in every
+    suite; ``all`` chains them and fails on the worst. Baselines recorded
+    on a different host WARN about their absolute (non-ratio) keys."""
 
     def _opt(flag, default=None):
         if flag in argv:
@@ -2134,18 +2500,74 @@ def gate_main(argv: list) -> int:
 
     suite = _opt("--suite", "kernels")
     tolerance = float(_opt("--tolerance", _GATE_TOLERANCE))
-    if suite not in ("kernels", "elastic", "serve", "data", "all"):
-        print(f"gate: unknown --suite {suite!r} (kernels|elastic|serve|data|all)", file=sys.stderr)
+    if suite not in ("kernels", "elastic", "serve", "data", "tier1", "all"):
+        print(
+            f"gate: unknown --suite {suite!r} (kernels|elastic|serve|data|tier1|all)",
+            file=sys.stderr,
+        )
         return 2
+
+    def _merged_baseline(patterns: list) -> dict | None:
+        """EVERY committed receipt matching ``patterns`` folds into ONE
+        merged baseline, each key at its most recently committed value
+        (receipts sorted by name; later receipts override earlier per key).
+        That is what keeps a silently-vanished metric a FAIL — every
+        receipt's keys stay enforced — without an older receipt's stale
+        absolute numbers resurrecting as floors. Receipts from a different
+        host WARN about their absolute keys on the way in."""
+        import glob as _glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        receipts: list = []
+        for pat in patterns:
+            receipts.extend(_glob.glob(os.path.join(here, pat)))
+        if not receipts:
+            return None
+        merged: dict = {}
+        for path in sorted(receipts):
+            with open(path) as f:
+                receipt = json.load(f)
+            _warn_if_cross_host(receipt, os.path.basename(path))
+            merged.update(_gate_metrics(receipt))
+        return {"gate": merged}
 
     rcs = []
     if suite in ("kernels", "all"):
-        baseline = _opt("--baseline") if suite == "kernels" else None
-        baseline = baseline or _latest_kernels_receipt()
+        explicit = _opt("--baseline") if suite == "kernels" else None
+        if explicit is not None:
+            baseline = explicit
+        else:
+            # kernel receipts AND the quantized-training receipts merge into
+            # one baseline (PR 16): the train_int8_* keys are enforced the
+            # same way the serve suite enforces serve_prefix_* — a vanished
+            # metric FAILS, the latest committed value is the floor
+            baseline = _merged_baseline(["BENCH_kernels_*.json", "BENCH_train_*.json"])
         if baseline is None:
-            print("gate: FAIL — no --baseline and no committed BENCH_kernels_*.json", file=sys.stderr)
+            print(
+                "gate: FAIL — no --baseline and no committed BENCH_kernels_*.json"
+                " / BENCH_train_*.json",
+                file=sys.stderr,
+            )
             return 2
-        rcs.append(run_gate(baseline, _opt("--current") if suite == "kernels" else None, tolerance))
+        current = _opt("--current") if suite == "kernels" else None
+        if current is None and (
+            not isinstance(baseline, dict) or any(
+                k.startswith("train_") for k in baseline["gate"]
+            )
+        ):
+            # the merged baseline carries train_int8_* keys, so the current
+            # run must produce them too: both CPU-pinned children run and
+            # their gate sections merge (missing either child = FAIL)
+            print("gate: measuring current kernel ratios (CPU-pinned child)...", file=sys.stderr)
+            cur_k = bench_kernels()
+            print("gate: running the quantized-training A/B (train-quant child)...", file=sys.stderr)
+            cur_t = bench_train_quant()
+            if cur_k is None or cur_t is None:
+                which = "kernels" if cur_k is None else "train-quant"
+                print(f"gate: FAIL — {which} child produced no results", file=sys.stderr)
+                return 2
+            current = {"gate": {**_gate_metrics(cur_k), **_gate_metrics(cur_t)}}
+        rcs.append(run_gate(baseline, current, tolerance))
     if suite in ("elastic", "all"):
         baseline = _opt("--baseline") if suite == "elastic" else None
         baseline = baseline or _latest_receipt("elastic")
@@ -2165,25 +2587,15 @@ def gate_main(argv: list) -> int:
         if explicit is not None:
             baseline = explicit
         else:
-            # EVERY committed serve receipt folds into ONE merged baseline,
-            # each key at its most recently committed value (receipts
-            # sorted by name; later receipts override earlier per key).
-            # That is what makes a silently-vanished serve_prefix_* metric
-            # a FAIL — the pr11 receipt's keys stay enforced — without an
-            # older receipt's stale absolute numbers (e.g. pr08's tokens/s
-            # from a different box era) resurrecting as floors.
-            import glob as _glob
-
-            here = os.path.dirname(os.path.abspath(__file__))
-            receipts = sorted(_glob.glob(os.path.join(here, "BENCH_serve_*.json")))
-            if not receipts:
+            # EVERY committed serve receipt folds into ONE merged baseline —
+            # a silently-vanished serve_prefix_* (or serve_medusa_*) metric
+            # FAILS while an older receipt's stale absolute numbers (e.g.
+            # pr08's tokens/s from a different box era) do not resurrect as
+            # floors (_merged_baseline).
+            baseline = _merged_baseline(["BENCH_serve_*.json"])
+            if baseline is None:
                 print("gate: FAIL — no --baseline and no committed BENCH_serve_*.json", file=sys.stderr)
                 return 2
-            merged: dict = {}
-            for path in receipts:
-                with open(path) as f:
-                    merged.update(_gate_metrics(json.load(f)))
-            baseline = {"gate": merged}
         current = _opt("--current") if suite == "serve" else None
         if current is None:
             print("gate: running the serving A/B (serve suite child)...", file=sys.stderr)
@@ -2206,7 +2618,67 @@ def gate_main(argv: list) -> int:
                 print("gate: FAIL — data bench child produced no results", file=sys.stderr)
                 return 2
         rcs.append(run_gate(baseline, current, tolerance))
+    if suite == "tier1":
+        # NOT part of --suite all: this one runs the whole tier-1 test
+        # suite (CI runs it separately anyway) and gates its WALL TIME as a
+        # lower-is-better latency against the last committed
+        # BENCH_tier1_*.json — the budget receipt of the fixture-sharing /
+        # slow-marker work, so a suite that quietly doubles fails here
+        # before it times out the real CI job.
+        baseline = _opt("--baseline") or _latest_receipt("tier1")
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_tier1_*.json", file=sys.stderr)
+            return 2
+        current = _opt("--current")
+        if current is None:
+            print("gate: timing the tier-1 suite (pytest child)...", file=sys.stderr)
+            current = bench_tier1()
+            if current is None:
+                print("gate: FAIL — tier-1 suite child produced no results", file=sys.stderr)
+                return 2
+        rcs.append(run_gate(baseline, current, tolerance))
     return max(rcs)
+
+
+def bench_tier1(timeout_s: int = 870) -> dict | None:
+    """Time the tier-1 suite (the CI verify command, CPU-pinned, ``-m 'not
+    slow'``) and return a receipt-shaped dict: wall seconds as a
+    lower-is-better gate metric plus the pass/fail bit. ``timeout_s``
+    defaults to the CI budget — a suite that exceeds it returns rc 124
+    semantics (tier1_exit_ok 0), not None, so the gate shows the number."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+        "--continue-on-collection-errors", "-p", "no:cacheprovider",
+        "-p", "no:xdist", "-p", "no:randomly",
+    ]
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        cmd, cwd=here, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        rc = 124
+    wall = time.perf_counter() - t0
+    tail = "\n".join((out or "").splitlines()[-3:])
+    return {
+        "value_source": "cpu_smoke",
+        "host": _host_fingerprint(),
+        "pytest_rc": rc,
+        "summary_tail": tail,
+        "gate": {
+            "tier1_suite_wall_s": round(wall, 1),
+            "tier1_exit_ok": int(rc == 0),
+        },
+    }
 
 
 _METRICS_WORKER = """
@@ -3211,6 +3683,8 @@ if __name__ == "__main__":
         serve_child_main()
     elif "--data-child" in sys.argv[1:]:
         data_child_main()
+    elif "--train-quant-child" in sys.argv[1:]:
+        train_quant_child_main()
     elif "--probe-child" in sys.argv[1:]:
         probe_child_main()
     elif "--gate" in sys.argv[1:]:

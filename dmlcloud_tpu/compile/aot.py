@@ -27,6 +27,13 @@ Abstract specs come from ``abstract_spec`` (any concrete or abstract pytree
 layout ``make_global_batch`` will produce for a host batch on a mesh).
 ``validate_global_batch_spec`` moves the classic step-1 crash — a batch dim
 the mesh cannot divide — to stage start.
+
+Quantized-training states precompile unchanged: the int8 step's params stay
+a plain fp32 tree (the ``QuantTrainTensor`` wrap happens INSIDE the traced
+loss closure, stage.py) and the delayed amax tree in
+``extras[models.quant.QUANT_AMAX_KEY]`` is ordinary array leaves, so the
+signature — and therefore the AOT cache key and the TraceGuard budget —
+is exactly the full-precision one.
 """
 
 from __future__ import annotations

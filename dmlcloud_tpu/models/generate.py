@@ -86,7 +86,7 @@ def _chunked_scan(step, carry, first_step, n_total, attend_len_for_end):
 
 def decode_step(
     model: DecoderLM, params, tokens, cache, *, offset=0, pad_len=None, attend_len=None,
-    pages=None, adapters=None,
+    pages=None, adapters=None, return_hidden=False,
 ):
     """THE cache-step primitive: one model application that writes
     ``tokens``' K/V into ``cache`` and returns ``(logits, new_cache)``.
@@ -103,10 +103,13 @@ def decode_step(
     scalar ``offset`` (with optional ``pad_len`` ragged-prompt positions
     and ``attend_len`` bounded reads), or the serving engine's pool pages
     stepped via ``pages=(block_tables, fill)``; ``adapters`` threads
-    per-row LoRA deltas for multi-tenant serving (``serve.AdapterSet``)."""
+    per-row LoRA deltas for multi-tenant serving (``serve.AdapterSet``).
+    ``return_hidden=True`` returns ``((logits, hidden), new_cache)`` — the
+    Medusa serving path reads the final hidden states for its extra decode
+    heads out of the SAME forward that produced the base logits."""
     return model.apply(
         {"params": params}, tokens, cache=cache, offset=offset, pad_len=pad_len,
-        attend_len=attend_len, pages=pages, adapters=adapters,
+        attend_len=attend_len, pages=pages, adapters=adapters, return_hidden=return_hidden,
     )
 
 

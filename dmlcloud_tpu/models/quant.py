@@ -24,8 +24,24 @@ Design:
   reduced over the kernel's leading input axes, so every trailing output
   coordinate keeps its own scale (see :func:`quantize`).
 - Weight-only: activations stay in the model's compute dtype. This is the
-  bandwidth-bound inference tradeoff — training and prefill (compute-
-  bound) keep full precision.
+  bandwidth-bound inference tradeoff — prefill (compute-bound) keeps full
+  precision.
+
+**Quantized training** (PR 16): the same fused-dot discipline applied to
+the train step. :class:`QuantTrainTensor` pairs a MASTER fp32 weight with
+a DELAYED per-channel scale (computed from the previous step's post-update
+amax, carried in ``TrainState.extras[QUANT_AMAX_KEY]`` — no per-step amax
+reduction on the forward's critical path, the fp8-recipe trick applied to
+int8). :func:`quant_train_dot` is a ``custom_vjp`` whose forward AND
+input-gradient matmuls consume the freshly-quantized int8 kernel through
+the same ``lax.dot_general`` operand convention as
+:func:`_fused_quant_dot`, while the WEIGHT gradient stays a full-precision
+``x^T @ g`` into the fp32 master (straight-through estimator: the
+round/clip's zero-a.e. derivative is replaced by identity). The optimizer,
+EMA shadow and checkpoint layout never see any of this — they hold plain
+fp32 params; ``TrainValStage(precision="int8")`` wraps kernels inside the
+compiled step's loss closure (:func:`wrap_train_tree`) and refreshes the
+amax tree from the post-update params (:func:`amax_tree`).
 """
 
 from __future__ import annotations
@@ -39,6 +55,7 @@ from flax import struct
 
 __all__ = [
     "QuantizedTensor",
+    "QuantTrainTensor",
     "QuantDense",
     "QuantDenseGeneral",
     "quantize",
@@ -47,7 +64,15 @@ __all__ = [
     "widen_quant_tree",
     "prepare_decode_params",
     "quantized_size",
+    "quant_train_dot",
+    "amax_tree",
+    "wrap_train_tree",
+    "QUANT_AMAX_KEY",
 ]
+
+#: extras key under which TrainValStage(precision="int8") carries the
+#: delayed per-channel amax tree (see amax_tree / wrap_train_tree)
+QUANT_AMAX_KEY = "quant_amax"
 
 
 class QuantizedTensor(struct.PyTreeNode):
@@ -107,6 +132,130 @@ def _fused_quant_dot(x: jax.Array, qt: QuantizedTensor, dtype) -> jax.Array:
     )  # [..., *out] fp32
     scale = qt.scale.reshape(q.shape[1:])  # drop the keepdims reduced axis
     return (acc * scale).astype(dtype)
+
+
+class QuantTrainTensor(struct.PyTreeNode):
+    """Quantized-TRAINING leaf: master fp32 weight ``w`` plus the DELAYED
+    per-output-channel ``scale`` (previous step's post-update amax / 127,
+    keepdims layout, exactly :class:`QuantizedTensor`'s). The wrapped leaf
+    lives only INSIDE the compiled train step's loss closure
+    (:func:`wrap_train_tree`); params, grads, optimizer state and
+    checkpoints stay plain fp32 trees."""
+
+    w: jax.Array
+    scale: jax.Array
+
+
+def _train_op_dtype(dtype):
+    # the same per-backend operand choice _fused_quant_dot makes: int8 is
+    # exact in bf16 and fp32, TPU MXUs eat narrow operands natively,
+    # XLA:CPU widens to the fp32 accumulator dtype (skipping the bf16
+    # GEMM-emulation tax — the measured CPU training win)
+    return dtype if jax.default_backend() == "tpu" else jnp.promote_types(jnp.float32, dtype)
+
+
+@jax.custom_vjp
+def quant_train_dot(x, w, scale):
+    """``x @ fake_quant(w)`` with int8 matmuls on BOTH the forward and the
+    input-gradient path, and a straight-through fp32 weight gradient.
+
+    - forward: ``q = clip(round(w / scale))`` int8 feeds ``lax.dot_general``
+      directly (the :func:`_fused_quant_dot` fusion — no dequantized copy),
+      per-channel ``scale`` multiplies the fp32 accumulator.
+    - ``dx = (g * scale) @ q^T``: the SAME int8 kernel re-feeds the
+      transposed dot, so the backward's activation-gradient GEMM is
+      quantized too (the residual holds ``q`` at 1 byte/element, not a
+      second fp32 weight copy).
+    - ``dw = x^T @ g`` in fp32 into the MASTER weight (straight-through:
+      the quantizer's round/clip differentiates as identity) and
+      ``dscale = 0`` — the scale is training STATE (delayed amax), never
+      a trained parameter.
+
+    Contracts ``x``'s last axis with ``w``'s first (the nn.Dense /
+    DenseGeneral(axis=-1) convention, kernels ``[in, *out]``)."""
+    y, _ = _quant_train_fwd(x, w, scale)
+    return y
+
+
+def _quant_train_fwd(x, w, scale):
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    op = _train_op_dtype(x.dtype)
+    acc = jax.lax.dot_general(
+        x.astype(op), q.astype(op),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    y = (acc * scale.reshape(q.shape[1:])).astype(x.dtype)
+    # the residual carries q int8 (1 byte/element), x, and a 0-size dtype
+    # token so dw lands in the master weight's own dtype
+    return y, (x, q, scale, jnp.zeros((0,), w.dtype))
+
+
+def _quant_train_bwd(res, g):
+    x, q, scale, wtok = res
+    op = _train_op_dtype(x.dtype)
+    n_out = q.ndim - 1
+    gs = g.astype(jnp.float32) * scale.reshape(q.shape[1:])
+    g_axes = tuple(range(g.ndim - n_out, g.ndim))
+    dx = jax.lax.dot_general(
+        gs.astype(op), q.astype(op),
+        ((g_axes, tuple(range(1, q.ndim))), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        x.astype(jnp.float32), g.astype(jnp.float32),
+        ((tuple(range(x.ndim - 1)), tuple(range(g.ndim - n_out))), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(wtok.dtype)
+    return dx, dw, jnp.zeros_like(scale)
+
+
+quant_train_dot.defvjp(_quant_train_fwd, _quant_train_bwd)
+
+
+def amax_tree(params: Any, match: Callable[[str, Any], bool] | None = None) -> Any:
+    """Per-output-channel ``max|w|`` of every matched kernel — the delayed-
+    scale state ``TrainValStage(precision="int8")`` carries in
+    ``extras[QUANT_AMAX_KEY]`` and refreshes from the POST-update params
+    each step (so step N's forward quantizes with step N-1's statistics;
+    step 0 seeds from the initial params in ``make_state``). Unmatched
+    leaves hold a 0-d zero placeholder, keeping the tree structure
+    identical to ``params`` for jit/donation/checkpointing. Default match:
+    ``lora.default_match`` (matrix-shaped kernels)."""
+    from .lora import _paths, default_match
+
+    matcher = match or default_match
+
+    def leaf_amax(path, leaf):
+        if not matcher(path, leaf):
+            return jnp.zeros((), jnp.float32)
+        w = jnp.asarray(leaf)
+        reduce_axes = tuple(range(min(1, w.ndim - 1)))
+        return jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes, keepdims=True)
+
+    return jax.tree_util.tree_map(leaf_amax, _paths(params), params)
+
+
+def wrap_train_tree(
+    params: Any, amax: Any, match: Callable[[str, Any], bool] | None = None
+) -> Any:
+    """Wrap every matched kernel as :class:`QuantTrainTensor` with the
+    delayed scale ``amax / 127`` (1.0 for all-zero channels, mirroring
+    :func:`quantize`). Called INSIDE the loss closure on the
+    differentiated params, so grads keep the plain-params structure: the
+    wrapper's ``w`` cotangent flows straight back to the leaf and the
+    stop-gradient'd scale contributes nothing."""
+    from .lora import _paths, default_match
+
+    matcher = match or default_match
+
+    def wrap(path, leaf, a):
+        if not matcher(path, leaf):
+            return leaf
+        scale = jnp.where(a > 0, a / 127.0, 1.0)
+        return QuantTrainTensor(w=leaf, scale=jax.lax.stop_gradient(scale))
+
+    return jax.tree_util.tree_map(wrap, _paths(params), params, amax)
 
 
 def _fusible(qt: QuantizedTensor) -> bool:
@@ -174,6 +323,11 @@ class QuantDense(nn.Dense):
         kernel = (
             self.get_variable("params", "kernel") if self.has_variable("params", "kernel") else None
         )
+        if isinstance(kernel, QuantTrainTensor):  # quantized TRAINING path
+            y = quant_train_dot(inputs.astype(self.dtype), kernel.w, kernel.scale)
+            if self.use_bias:
+                y = y + self.get_variable("params", "bias").astype(self.dtype)
+            return y
         if not isinstance(kernel, QuantizedTensor):
             return super().__call__(inputs)
         if not _fusible(kernel):  # exotic scale layout: correctness over speed
@@ -195,6 +349,15 @@ class QuantDenseGeneral(nn.DenseGeneral):
         kernel = (
             self.get_variable("params", "kernel") if self.has_variable("params", "kernel") else None
         )
+        if isinstance(kernel, QuantTrainTensor):
+            if self.axis != -1 or self.batch_dims:
+                raise NotImplementedError(
+                    "quantized training supports the axis=-1 DenseGeneral form only"
+                )
+            y = quant_train_dot(inputs.astype(self.dtype), kernel.w, kernel.scale)
+            if self.use_bias:
+                y = y + self.get_variable("params", "bias").astype(self.dtype)
+            return y
         if not isinstance(kernel, QuantizedTensor) or self.axis != -1 or self.batch_dims:
             if isinstance(kernel, QuantizedTensor):  # unsupported layout: dequantize locally
                 kernel = kernel.dequant(self.dtype)

@@ -61,7 +61,7 @@ import jax.numpy as jnp
 
 from .transformer import DecoderLM
 
-__all__ = ["speculative_generate", "verify_proposals"]
+__all__ = ["speculative_generate", "verify_proposals", "init_medusa_heads", "medusa_head_logits"]
 
 
 def _greedy(logits):
@@ -150,6 +150,54 @@ def verify_proposals(tlogits, dlogits, proposals, rng, temperature, top_k, top_p
         jnp.where(hit_eos, jnp.argmax(is_eos & ~seen_eos, axis=1) + 1, k + 1),
     ).astype(jnp.int32)
     return new_tokens, n_new, n_accept
+
+
+def init_medusa_heads(cfg, k: int, rng: jax.Array, lm_head_kernel=None):
+    """Parameters for ``k - 1`` Medusa decode heads (Cai et al., "Medusa:
+    Simple LLM Inference Acceleration Framework with Multiple Decoding
+    Heads"): head ``h`` predicts the token ``h + 2`` positions ahead of the
+    round's anchor from the SAME final hidden state the base ``lm_head``
+    reads — the ``k - 1`` heads cover a ``medusa_k = k`` round's lookahead
+    (the round's first position is always the last committed token), so a
+    Medusa round needs no heads at all when ``k == 1``.
+
+    Each head is one Medusa-1 residual block over the hidden state::
+
+        logits_h = (hidden + silu(hidden @ w1[h] + b1[h])) @ w2[h]
+
+    stacked across heads: ``w1 [k-1, D, D]``, ``b1 [k-1, D]``,
+    ``w2 [k-1, D, V]`` (fp32 — the proposal distributions feed the exact
+    rejection-sampling verify). ``w1``/``b1`` start at ZERO, so a fresh
+    head's block is the identity over the hidden state; with
+    ``lm_head_kernel`` ([D, V], the base model's unembedding) every head
+    then starts as an exact copy of the base next-token head — the
+    standard warm start for head distillation. Without it ``w2`` draws
+    small normals. ``k == 1`` returns empty (0-head) stacks, which
+    ``medusa_head_logits`` maps to an empty ``[B, 0, V]``."""
+    if k < 1:
+        raise ValueError(f"k (proposals per Medusa round) must be >= 1, got {k}")
+    d, v, h = cfg.hidden_dim, cfg.vocab_size, k - 1
+    if lm_head_kernel is not None:
+        w2 = jnp.broadcast_to(jnp.asarray(lm_head_kernel, jnp.float32)[None], (h, d, v))
+    else:
+        w2 = 0.02 * jax.random.normal(rng, (h, d, v), jnp.float32)
+    return {
+        "w1": jnp.zeros((h, d, d), jnp.float32),
+        "b1": jnp.zeros((h, d), jnp.float32),
+        "w2": jnp.asarray(w2, jnp.float32),
+    }
+
+
+def medusa_head_logits(heads, hidden):
+    """Apply every Medusa head to one batch of final hidden states:
+    ``hidden [B, D]`` -> ``[B, k-1, V]`` fp32, row ``h`` the block-``h``
+    head's logits (``init_medusa_heads``' residual form). All heads run as
+    two stacked einsums — one fused matmul pair per round, not a Python
+    loop over heads."""
+    hidden = hidden.astype(jnp.float32)
+    pre = jnp.einsum("bd,hde->bhe", hidden, heads["w1"]) + heads["b1"][None]
+    res = hidden[:, None, :] + jax.nn.silu(pre)
+    return jnp.einsum("bhd,hdv->bhv", res, heads["w2"])
 
 
 def _row_spec_decode(

@@ -445,7 +445,12 @@ class DecoderLM(nn.Module):
     boundaries (pair with ``lm_loss(..., segment_ids=...)``).
     ``adapters=(stacked_tree, ids)`` applies per-row LoRA deltas gathered
     by adapter id inside every dense layer (multi-tenant serving; see
-    ``serve.AdapterSet``)."""
+    ``serve.AdapterSet``).
+
+    ``return_hidden=True`` without a cache returns the final hidden states
+    instead of logits (the chunked-vocab loss path); WITH a cache it
+    returns ``((logits, hidden), new_cache)`` — one decode forward feeding
+    both the base distribution and any extra decode heads (Medusa)."""
 
     cfg: TransformerConfig
 
@@ -538,11 +543,9 @@ class DecoderLM(nn.Module):
                 )
 
         x = RMSNorm(name="final_norm")(x)
-        if return_hidden:
+        if return_hidden and new_cache is None:
             # the chunked-vocab loss path (chunked_lm_loss) consumes the
             # final hidden states directly and never materializes logits
-            if new_cache is not None:
-                raise ValueError("return_hidden is a training-path feature (no cache)")
             return x
         if cfg.tie_embeddings:
             embed = self.variables["params"]["embed"]["embedding"]
@@ -555,7 +558,14 @@ class DecoderLM(nn.Module):
             )(x)
             if adapter_tree is not None:
                 logits = _adapter_add(logits, x, "lm_head", (adapter_tree, adapter_ids))
-        return logits if new_cache is None else (logits, new_cache)
+        if new_cache is None:
+            return logits
+        if return_hidden:
+            # cache-stepping callers (Medusa decode heads) need the final
+            # hidden states NEXT TO the base logits — one forward feeds the
+            # base distribution and every extra head
+            return (logits, x), new_cache
+        return logits, new_cache
 
 
 def chunked_lm_loss(

@@ -12,9 +12,13 @@ static batch per call; this package turns it into a serving engine:
 - :class:`ServeEngine` (engine.py): the loop — bucketed decode shapes
   (0 mid-run recompiles, TraceGuard-enforced), greedy output
   token-identical to serial ``generate()``; per-REQUEST sampling params
-  (mixed greedy/sampled tenants in one batch) and speculative decoding
-  (``spec_k`` draft proposals per round against a second page pool, one
-  k+1-position verify pass, partial-accept rewind by fill counters).
+  (mixed greedy/sampled tenants in one batch) and TWO speculative modes:
+  draft-model decoding (``spec_k`` proposals per round against a second
+  page pool, one k+1-position verify pass, partial-accept rewind by fill
+  counters) and Medusa decoding (``medusa_k`` proposals from extra decode
+  heads on the frozen base model — same verify and rewind, but the draft
+  model, its prefill mirror and the whole second page pool are gone;
+  ``models.speculative.init_medusa_heads`` shapes the heads).
 - :class:`PrefixCache` (prefix_cache.py): radix-tree prefix sharing over
   content-addressed, refcounted pool blocks — a warm template's prefill
   shrinks to its unique suffix; copy-on-write forks protect shared pages;

@@ -13,10 +13,11 @@ stalls running generations; a stream that emits EOS frees its slot and
 blocks before the next step, and the next waiting request takes them —
 continuous batching, no drain barrier.
 
-**Two decode modes share that loop:**
+**Three decode modes share that loop:**
 
-- *Plain* (``spec_k == 0``): one jitted ``_paged_step`` advances every
-  row one token per step — the PR-8 engine, unchanged semantics.
+- *Plain* (``spec_k == medusa_k == 0``): one jitted ``_paged_step``
+  advances every row one token per step — the PR-8 engine, unchanged
+  semantics.
 - *Speculative* (``spec_k >= 1``): a draft model (or the target itself —
   shared-model self-draft, ``models/speculative.py``'s smoke config)
   proposes ``k`` tokens per round against its OWN page pool, and one
@@ -32,6 +33,25 @@ continuous batching, no drain barrier.
   token the target pays ``~1/(accepted+1)`` of a weight-streaming pass —
   the per-token cost of the weight-bandwidth-bound decode loop becomes a
   per-round cost.
+- *Medusa* (``medusa_k >= 1``): the separate draft model, its prefill
+  mirror and the entire second page pool are GONE from the speculative
+  path. ``k - 1`` lightweight decode heads
+  (:func:`models.speculative.init_medusa_heads` — one residual block
+  each, riding the FROZEN base model) read the final hidden state out of
+  the round's ONE verify forward (``decode_step(...,
+  return_hidden=True)``) and emit the NEXT round's proposals on the way
+  out, so a round is a single ``k``-position target pass committing up
+  to ``k`` tokens — the proposals ride the round's packed token fetch as
+  ``k - 1`` host ints, never a second forward. Verification is the SAME
+  ``verify_proposals`` + fill-counter rewind as spec mode (proposals are
+  the heads' argmax picks, i.e. one-hot draft rows — rejection sampling
+  stays exact for sampled rows), fused into the ONE ``_medusa_step``
+  signature per (batch x table) bucket — the signature budget SHRINKS vs
+  spec mode (no draft prefill, no second per-round step) and
+  ``leaked_blocks`` has no draft pool to count. Because there is only
+  one model, the heads propose from the ADAPTED hidden state under
+  per-row LoRA — the proposer sees the tenant delta spec mode's
+  base-model draft never did.
 
 **Prefix sharing** (``prefix_cache=True``, serve/prefix_cache.py): pool
 blocks become content-addressed and refcounted, indexed by a radix tree
@@ -237,6 +257,71 @@ def _spec_verify_step(
     return packed, pools
 
 
+def _medusa_step(
+    pools, params, heads, tables, fill, last_tok, proposals, rng,
+    temperature, top_k, top_p, eos_id, adapters, *, model, k,
+):
+    """One whole Medusa round as a SINGLE model forward: the round's
+    proposals were produced by the PREVIOUS round's forward (the heads
+    read its final hidden state), so this step only verifies them and
+    emits the next round's proposals on the way out — no draft model, no
+    second pool, no second prefill, no dedicated propose pass anywhere.
+
+    Verify: the spec-mode shape shrunk by one — ``[y_last, q_1..q_{k-1}]``
+    written at ``fill..fill+k-1`` through the block tables, then
+    :func:`models.speculative.verify_proposals` with each row's own
+    params. Proposals are the heads' ARGMAX picks, so each draft
+    distribution is exactly one-hot at the proposed token — rejection
+    sampling against a one-hot ``q`` preserves every sampled row's
+    truncated target distribution exactly (accept w.p. ``p_t(q)``, else
+    sample the renormalised residual), and greedy rows stay
+    token-identical to plain decode at ANY accept rate.
+
+    Propose (for the NEXT round): ``hidden[:, n_accept]`` is the state
+    that produced this round's correction token, so head ``h``
+    (``models.speculative.medusa_head_logits`` — one fused matmul pair,
+    not k-1 extra forwards) predicts the ``(h+1)``-th token after it.
+    Unlike spec mode the proposer sees the tenant's LoRA delta for free —
+    the heads read the ADAPTED hidden state out of the verify forward.
+    ``k == 1`` has no heads and degenerates to plain one-token decode
+    through the medusa signature.
+
+    Returns ``(packed [B, 2k+1] (k>1) / [B, 3] (k=1), pools)`` — committed
+    tokens, the ``n_new``/``n_accept`` counters AND the next proposals in
+    ONE fetch (DML210). ``pools`` is donated."""
+    from ..models.generate import decode_step, sample_logits_batched
+    from ..models.speculative import medusa_head_logits, verify_proposals
+
+    x = (
+        jnp.concatenate([last_tok[:, None], proposals], axis=1)
+        if k > 1 else last_tok[:, None]
+    )  # [B, k]
+    (tlogits, hidden), pools = decode_step(
+        model, params, x, pools, pages=(tables, fill),
+        adapters=adapters, return_hidden=True,
+    )
+    tlogits = tlogits.astype(jnp.float32)
+    if k == 1:
+        tok = sample_logits_batched(tlogits[:, 0], rng, temperature, top_k, top_p)
+        packed = jnp.stack(
+            [tok, jnp.ones_like(tok), jnp.zeros_like(tok)], axis=1
+        )
+        return packed, pools
+    vocab = tlogits.shape[-1]
+    dlogits = jnp.where(
+        jax.nn.one_hot(proposals, vocab, dtype=bool), 0.0, -1e9
+    )  # one-hot at the argmax pick the proposal actually was
+    new_tokens, n_new, n_accept = verify_proposals(
+        tlogits, dlogits, proposals, rng, temperature, top_k, top_p, eos_id
+    )
+    h_acc = jnp.take_along_axis(hidden, n_accept[:, None, None], axis=1)[:, 0]
+    nxt = jnp.argmax(medusa_head_logits(heads, h_acc), axis=-1).astype(jnp.int32)
+    packed = jnp.concatenate(
+        [new_tokens, n_new[:, None], n_accept[:, None], nxt], axis=1
+    )
+    return packed, pools
+
+
 def _pow2_buckets(limit: int) -> tuple[int, ...]:
     """1, 2, 4, ... capped at (and always including) ``limit``."""
     out, b = [], 1
@@ -269,6 +354,17 @@ class ServeEngine:
       self-draft: the target drafts for itself — the correctness smoke,
       accept rate exactly 1.0 under greedy); ``draft_num_blocks`` sizes
       the draft page pool (default: the target pool's count).
+    - ``medusa_k`` / ``medusa_heads``: Medusa decoding — up to ``medusa_k``
+      tokens per round from ``medusa_k - 1`` extra decode heads on the
+      frozen base model, one ``k``-position forward per round (mutually
+      exclusive with ``spec_k``; no draft model, no draft pool).
+      ``medusa_heads`` is the
+      :func:`models.speculative.init_medusa_heads`-shaped stack (usually
+      distilled offline); None warm-starts every head from the base
+      ``lm_head`` — correct but with self-agreement accept rates only.
+      ``medusa_k=1`` has no heads and degenerates to plain one-token
+      decode through the medusa signature — the correctness smoke.
+      Output is token-identical to plain decode at ANY accept rate.
     - ``adapters``: an :class:`AdapterSet` for multi-tenant LoRA serving;
       requests pick a tenant by name. Composes with ``spec_k``: the
       base-model draft proposes WITHOUT the tenant's delta (costing only
@@ -304,6 +400,8 @@ class ServeEngine:
         draft_model=None,
         draft_params: Any = None,
         draft_num_blocks: int | None = None,
+        medusa_k: int = 0,
+        medusa_heads: Any = None,
         adapters: AdapterSet | None = None,
         prefix_cache: bool = False,
         rng: jax.Array | None = None,
@@ -325,10 +423,16 @@ class ServeEngine:
 
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if medusa_k < 0:
+            raise ValueError(f"medusa_k must be >= 0, got {medusa_k}")
+        if spec_k and medusa_k:
+            raise ValueError("spec_k and medusa_k are mutually exclusive decode modes")
         if (draft_model is None) != (draft_params is None):
             raise ValueError("draft_model and draft_params must be passed together")
         if draft_model is not None and spec_k < 1:
             raise ValueError("a draft model needs spec_k >= 1")
+        if medusa_heads is not None and medusa_k < 1:
+            raise ValueError("medusa_heads need medusa_k >= 1")
         self.model = model
         cfg = model.cfg
         # one-time host-side preparation: int8 kernels stay fused-quantized
@@ -357,13 +461,33 @@ class ServeEngine:
                 block_size=block_size,
                 dtype=cache_dtype,
             )
+        self.medusa_k = int(medusa_k)
+        self.medusa_heads = None
+        if self.medusa_k:
+            # Medusa mode: NO draft model, NO draft pool, NO draft prefill
+            # mirror — k-1 extra decode heads ride the target's own forward.
+            # Default heads (none passed) are fresh zero-residual blocks
+            # warm-started from the base lm_head: correct but untrained
+            # (accept rate ~= self-agreement); callers distil real ones.
+            from ..models.speculative import init_medusa_heads
+
+            if medusa_heads is not None:
+                self.medusa_heads = jax.tree.map(jnp.asarray, medusa_heads)
+            else:
+                kernel = None
+                raw = params.get("lm_head") if hasattr(params, "get") else None
+                if raw is not None and not cfg.tie_embeddings:
+                    kernel = raw.get("kernel")
+                self.medusa_heads = init_medusa_heads(
+                    cfg, self.medusa_k, jax.random.PRNGKey(0), lm_head_kernel=kernel
+                )
         # prefix sharing: the radix tree lives over the TARGET pool only —
         # the draft pool has no tree (draft prefill skips via the target's
         # match length; the verifier guarantees token identity regardless)
         self.prefix = PrefixCache(self.pool) if prefix_cache else None
         self.scheduler = Scheduler(
             self.pool, max_slots, prefill_chunk,
-            draft_pool=self.draft_pool, lookahead=self.spec_k,
+            draft_pool=self.draft_pool, lookahead=self.spec_k or self.medusa_k,
             prefix_cache=self.prefix,
             max_waiting=max_waiting, shed_policy=shed_policy,
             fairness=fairness, drr_quantum=drr_quantum,
@@ -394,7 +518,8 @@ class ServeEngine:
         self.watchdog = watchdog
         #: chaos hook: ``fn(point, seqs)`` called at "step" (must not
         #: raise) and before each device phase ("prefill"/"decode"/
-        #: "draft"/"verify", where raising injects a fault) — serve/chaos.py
+        #: "draft"/"verify" — the fused Medusa round fires "verify" —
+        #: where raising injects a fault) — serve/chaos.py
         self.fault_injector: Callable[[str, Any], None] | None = None
         self._drain_reason: str | None = None
         self._drain_kind = "completed"
@@ -438,6 +563,20 @@ class ServeEngine:
             self.max_signatures = self._step_budget + 2 * self._spec_budget
             self._draft_fn = _guarded(_spec_draft_step, self._spec_budget, "serve_spec_draft")
             self._verify_fn = _guarded(_spec_verify_step, self._spec_budget, "serve_spec_verify")
+        elif self.medusa_k:
+            #: Medusa-mode budget: prefill is (1, chunk) x table bucket for
+            #: the TARGET ONLY (no draft mirror — that's the point), plain
+            #: decode keeps its (batch bucket x table bucket) fallback for
+            #: degraded rounds, and each healthy round is ONE fused
+            #: propose+verify signature per (batch bucket x table bucket).
+            #: vs spec mode the budget SHRINKS by n_tb (draft prefill) +
+            #: n_bb*n_tb (the second per-round signature): there is no
+            #: draft anything to trace.
+            self._step_budget = n_bb * n_tb + n_tb
+            self._medusa_budget = n_bb * n_tb
+            self.max_signatures = self._step_budget + self._medusa_budget
+            self._draft_fn = self._verify_fn = None
+            self._medusa_fn = _guarded(_medusa_step, self._medusa_budget, "serve_medusa_step")
         else:
             #: the engine's whole compiled-signature budget: decode is
             #: (batch bucket x table bucket), prefill is (1, chunk) x table
@@ -445,6 +584,8 @@ class ServeEngine:
             self._step_budget = n_bb * n_tb + n_tb
             self.max_signatures = self._step_budget
             self._draft_fn = self._verify_fn = None
+        if not self.medusa_k:
+            self._medusa_fn = None
         self._step_fn = _guarded(_paged_step, self._step_budget, "serve_paged_step")
         self._copy_fn = None
         if self.prefix is not None:
@@ -495,14 +636,16 @@ class ServeEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
-        # spec rounds may write up to k proposals past the final committed
-        # slot (plus the bonus slot) — the same slack speculative_generate
-        # reserves; plain decode keeps the exact PR-8 bound
-        slack = self.spec_k + 1 if self.spec_k else 0
+        # spec/medusa rounds may write up to k proposals past the final
+        # committed slot (plus the bonus slot) — the same slack
+        # speculative_generate reserves; plain decode keeps the PR-8 bound
+        lookahead = self.spec_k or self.medusa_k
+        slack = lookahead + 1 if lookahead else 0
         if prompt.size + int(max_new_tokens) + slack > self.model.cfg.max_seq_len:
+            knob = "spec_k" if self.spec_k else "medusa_k"
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens})"
-                + (f" + spec_k+1 ({slack})" if slack else "")
+                + (f" + {knob}+1 ({slack})" if slack else "")
                 + f" exceeds max_seq_len ({self.model.cfg.max_seq_len})"
             )
         aid = 0
@@ -632,7 +775,8 @@ class ServeEngine:
         """Distinct compiled signatures so far, summed over the engine's
         jitted steps (the TraceGuard probes)."""
         total = 0
-        for fn in (self._step_fn, self._draft_fn, self._verify_fn, self._copy_fn):
+        for fn in (self._step_fn, self._draft_fn, self._verify_fn,
+                   self._medusa_fn, self._copy_fn):
             if fn is None:
                 continue
             n = fn.cache_size()
@@ -645,7 +789,8 @@ class ServeEngine:
     def step(self) -> bool:
         """One engine iteration: expire deadlines, admit (or drain), one
         prefill chunk, one decode batch (a speculative round when
-        ``spec_k``). Returns whether any device work ran. A failure in
+        ``spec_k``, a Medusa round when ``medusa_k``). Returns whether
+        any device work ran. A failure in
         either device phase is isolated to the request(s) it was
         advancing — the step itself never raises for a per-request
         fault."""
@@ -694,6 +839,8 @@ class ServeEngine:
             try:
                 if self.spec_k:
                     self._decode_spec(batch)
+                elif self.medusa_k:
+                    self._decode_medusa(batch)
                 else:
                     self._decode(batch)
             except Exception as exc:  # noqa: BLE001 — isolate to these rows
@@ -1089,17 +1236,108 @@ class ServeEngine:
                     break
                 s.prev_token = prev_last
 
-    def _degrade_round(self, batch, t0: float, bb: int, exc: BaseException) -> None:
-        """A failed DRAFT step degrades the round to plain decode: the
-        draft only ever proposes, so losing it costs proposals (no
-        ``spec_round`` events this round — accept counters stay exact),
-        never correctness or identity. The draft cache misses the
-        degraded token's slot; the next healthy round's 2-token leading
-        rewrite closes one slot and any unwritten remainder only costs
-        accept rate (the same posture as prefix-skipped draft prefill).
+    def _decode_medusa(self, batch) -> None:
+        """One Medusa round for the whole decode batch: ONE model forward
+        (``_medusa_step``) verifies the proposals the PREVIOUS round's
+        forward emitted — no draft model, no draft pool, no draft tables,
+        no propose pass. Each sequence carries its pending proposals as
+        ``k-1`` host ints (``seq.medusa_pending``, part of the round's
+        single packed fetch); a row's FIRST round after prefill has none
+        yet and runs on sentinel proposals (one near-plain round, never a
+        correctness cost — the verify rule rejects them). The commit loop
+        and partial-accept rewind are exactly the spec-mode ones: fill
+        counters roll forward only to the accepted position; stale
+        speculative K/V past fill is overwritten by the next round's
+        contiguous writes before the causal mask can expose it."""
+        k = self.medusa_k
+        for s in batch:
+            # a round writes fill..fill+k-1 (verify) — COW/refcount check
+            # before the multi-token scatter (DML211)
+            self._cow_guard(s, s.fill, s.fill + k)
+        bb = bucket_for(len(batch), self.batch_buckets)
+        needed = max(
+            s.needed_blocks(self.pool.block_size, lookahead=k) for s in batch
+        )
+        nb = bucket_for(needed, self.table_buckets)
+        tables = np.full((bb, nb), self.pool.sentinel, np.int32)
+        tables[: len(batch)] = self._table_rows(batch, nb)
+        # pad rows: fill=1 keeps every traced position >= 0 and the
+        # attention mask non-empty; their sentinel tables drop all writes
+        fill = np.ones(bb, np.int32)
+        last = np.zeros(bb, np.int32)
+        prop = np.zeros((bb, max(k - 1, 0)), np.int32)
+        for i, s in enumerate(batch):
+            fill[i] = s.fill
+            last[i] = s.last_token
+            pending = getattr(s, "medusa_pending", None)
+            if pending is not None and k > 1:
+                prop[i] = pending
+        temps, topks, topps, eos = self._row_params(batch, bb)
+        adapters = None
+        if self.adapters is not None:
+            # medusa x LoRA: ONE model means the heads propose from the
+            # ADAPTED hidden state — unlike spec mode, the proposer sees
+            # the tenant's delta for free
+            ids = np.zeros(bb, np.int32)
+            for i, s in enumerate(batch):
+                ids[i] = s.adapter_id
+            adapters = (self.adapters.stacked, jnp.asarray(ids, jnp.int32))
+        tables = jnp.asarray(tables, jnp.int32)
+        fill = jnp.asarray(fill, jnp.int32)
+        last = jnp.asarray(last, jnp.int32)
+        prop = jnp.asarray(prop, jnp.int32)
+
+        t0 = journal.now()
+        try:
+            self._chaos("verify", batch)
+            packed, tpools = self._medusa_fn(
+                self.pool.pools, self.params, self.medusa_heads, tables, fill,
+                last, prop, self._next_rng(), temps, topks, topps, eos, adapters,
+                model=self.model, k=k,
+            )
+        except Exception as exc:  # noqa: BLE001 — the heads are an optimization
+            for s in batch:
+                # the degraded plain step shifts every row one position, so
+                # carried proposals would be stale by one — drop them
+                s.medusa_pending = None
+            self._degrade_round(batch, t0, bb, exc, label="medusa_degrade")
+            return
+        self.pool.swap(tpools)
+        # ONE fetch: tokens and the n_new/n_accept counters ride together
+        out = np.asarray(packed)
+        now = time.perf_counter()
+        journal.emit("medusa", t0, label=f"b{bb}", active=len(batch),
+                     bucket=bb, blocks=nb, k=k)
+        self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        for i, s in enumerate(batch):
+            n_new = int(out[i, k])
+            if k > 1:
+                self.ledger.spec_round(
+                    s.req.id, drafted=k - 1, accepted=int(out[i, k + 1])
+                )
+                s.medusa_pending = out[i, k + 2 : 2 * k + 1].copy()
+            for tok in out[i, :n_new]:
+                prev_last = s.last_token
+                s.fill += 1  # this token's K/V was written by the round
+                self._emit(s, int(tok), now)
+                if s.finished is not None:
+                    break
+                s.prev_token = prev_last
+
+    def _degrade_round(self, batch, t0: float, bb: int, exc: BaseException,
+                       label: str = "draft_degrade") -> None:
+        """A failed PROPOSE step (the spec draft or the fused Medusa
+        round) degrades the round to plain decode: proposals are an
+        optimization, so losing them costs throughput (no ``spec_round``
+        events this round — accept counters stay exact), never
+        correctness or identity. The draft cache misses the degraded
+        token's slot; the next healthy round's 2-token leading rewrite
+        closes one slot and any unwritten remainder only costs accept
+        rate (the same posture as prefix-skipped draft prefill). Medusa
+        has no second cache, so its degraded round loses nothing at all.
         A failure inside the fallback decode propagates to ``step``'s
         handler, which fails the batch."""
-        journal.emit("fault", t0, label=f"b{bb}:draft_degrade", active=bb,
+        journal.emit("fault", t0, label=f"b{bb}:{label}", active=bb,
                      error=f"{type(exc).__name__}: {exc}")
         self._decode(batch)
 

@@ -32,16 +32,18 @@ cached-token fraction and the prefill-tokens-saved fraction — the numbers
 the ``BENCH_serve_prefix_*`` receipt gates.
 
 Speculative serving adds the accept-rate observables: per request, the
-tokens the draft proposed (``drafted``) and the tokens the verifier
-accepted (``accepted``) — counters that arrive packed in the same device
-fetch as the round's tokens (no extra readback; lint DML210), reduced in
+tokens proposed per round (``drafted`` — the spec draft model's, or the
+Medusa heads' in ``medusa_k`` mode) and the tokens the verifier accepted
+(``accepted``) — counters that arrive packed in the same device fetch as
+the round's tokens (no extra readback; lint DML210), reduced in
 ``summary()`` to total and per-request-mean accept rates.
 
 The ledger is pure host bookkeeping — O(1) dict/list appends per event,
 no device interaction — and rides next to the span journal: every record
 here corresponds to ``queue_wait`` / ``prefill`` / ``decode_batch`` (and
-``draft`` / ``verify`` in spec mode, ``fault`` / ``drain`` on the
-failure paths) spans when telemetry is armed, so a Perfetto timeline and
+``draft`` / ``verify`` in spec mode, ``medusa`` for the fused Medusa
+round, ``fault`` / ``drain`` on the failure paths) spans when telemetry
+is armed, so a Perfetto timeline and
 this summary never disagree about what the engine did.
 """
 

@@ -314,10 +314,24 @@ class TrainValStage(Stage):
     DELIBERATELY renamed: under async dispatch the loop-body time is host
     enqueue cost, so it ships as ``misc/step_dispatch_ms``, with
     ``misc/train_step_avg_ms`` carrying the wall-clock per-step average.
+
+    ``precision="int8"`` switches the compiled train step to quantized
+    training (models/quant.py): master fp32 weights stay the params the
+    optimizer, EMA shadow and checkpoints see, while INSIDE the step's
+    loss closure every matrix kernel is wrapped as a
+    :class:`~dmlcloud_tpu.models.quant.QuantTrainTensor` — int8 matmuls on
+    the forward and input-gradient paths, full-precision weight grads
+    (straight-through), per-channel scales DELAYED one step via the amax
+    tree carried in ``state.extras[QUANT_AMAX_KEY]`` and refreshed from
+    the post-update params. Validation always runs full precision on the
+    master weights.
     """
 
-    def __init__(self):
+    def __init__(self, precision: str = "full"):
         super().__init__()
+        if precision not in ("full", "int8"):
+            raise ValueError(f'precision must be "full" or "int8", got {precision!r}')
+        self._precision = str(precision)
         self.is_train = True
         self.state: TrainState | None = None
         self._policy: Any = "replicate"
@@ -393,6 +407,14 @@ class TrainValStage(Stage):
     def gradient_clip(self) -> float:
         """Global-norm clip threshold; 0 disables (reference stage.py:256-257)."""
         return 0.0
+
+    def precision(self) -> str:
+        """Matmul precision of the compiled TRAIN step: ``"full"`` (the
+        model's own dtype) or ``"int8"`` (quantized training — see the
+        class docstring and models/quant.py). A knob method like its
+        neighbours so subclasses may override instead of passing the
+        constructor arg."""
+        return self._precision
 
     def gradient_accumulation(self) -> int:
         """Number of microbatches to accumulate per optimizer step (1
@@ -611,12 +633,22 @@ class TrainValStage(Stage):
             )
 
         stage_index = self.pipeline.stages.index(self) if self in self.pipeline.stages else 0
+        params = fresh(entry.params)
+        extras = fresh(entry.extras) if entry.extras is not None else None
+        if self.precision() == "int8":
+            # seed the delayed-scale state: step 0 quantizes with the
+            # INITIAL params' amax (models/quant.py — every later step
+            # uses the previous step's post-update statistics)
+            from .models.quant import QUANT_AMAX_KEY, amax_tree
+
+            extras = dict(extras or {})
+            extras[QUANT_AMAX_KEY] = amax_tree(params)
         return TrainState.create(
             apply_fn=entry.apply_fn,
-            params=fresh(entry.params),
+            params=params,
             tx=tx,
             rng=jax.random.fold_in(self.pipeline.root_key, stage_index),
-            extras=fresh(entry.extras) if entry.extras is not None else None,
+            extras=extras,
             ema=True if float(self.ema_decay()) > 0.0 else None,
             mesh=self.mesh,
             policy=entry.policy,
@@ -639,11 +671,21 @@ class TrainValStage(Stage):
         clip = float(self.gradient_clip())
         accum = int(self.gradient_accumulation())
         ema_decay = float(self.ema_decay())
+        int8 = self.precision() == "int8"
+        if int8:
+            from .models.quant import QUANT_AMAX_KEY, amax_tree, wrap_train_tree
 
         def train_step(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_fn(params, extras, rng, mb):
+                if int8:
+                    # wrap INSIDE the differentiated closure: grads keep
+                    # the plain-params structure, the user's step sees
+                    # QuantTrainTensor kernels the QuantDense layers
+                    # dispatch on (models/quant.py), and the delayed
+                    # scales ride in from the previous step's extras
+                    params = wrap_train_tree(params, extras[QUANT_AMAX_KEY])
                 out = self.train_step(state.replace(params=params, extras=extras, rng=rng), mb)
                 # step may return loss | (loss, metrics) | (loss, metrics, new_extras)
                 if not isinstance(out, tuple):
@@ -666,6 +708,13 @@ class TrainValStage(Stage):
                 scale = jnp.minimum(1.0, clip * gnorm)
                 grads = jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), grads)
             new_state = state.apply_gradients(grads).replace(extras=new_extras)
+            if int8:
+                # delayed scaling: the NEXT step quantizes with THIS
+                # step's post-update amax — one fused reduction here, no
+                # statistics pass on the forward's critical path
+                new_state = new_state.replace(
+                    extras={**new_state.extras, QUANT_AMAX_KEY: amax_tree(new_state.params)}
+                )
             if ema_decay > 0.0:
                 new_state = new_state.update_ema(ema_decay)
             metrics = dict(metrics)

@@ -32,6 +32,11 @@ class TrainState(struct.PyTreeNode):
     #: the reference's SyncBN buffers (pipeline.py:70-71): with the batch
     #: sharded over ``data``, computing stats inside the jitted step with an
     #: ``axis_name`` psum gives synchronised statistics for free.
+    #: Quantized training (``TrainValStage(precision="int8")``) also rides
+    #: here: ``extras[models.quant.QUANT_AMAX_KEY]`` carries the delayed
+    #: per-channel amax tree the next step's fake-quant scales derive from —
+    #: training state, not a parameter, so it shards, donates, checkpoints
+    #: and resumes with everything else for free.
     extras: Any = None
     #: optional exponential-moving-average shadow of ``params`` (same tree,
     #: same shapes, same shardings). Maintained by ``update_ema`` inside the

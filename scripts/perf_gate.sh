@@ -1,27 +1,41 @@
 #!/usr/bin/env bash
-# CI perf gate, four suites (doc/performance.md §"Kernel receipts",
+# CI perf gate, five suites (doc/performance.md §"Kernel receipts",
 # doc/elasticity.md, doc/serving.md, doc/data.md):
 #
 #   kernels  current kernel ratios (flash fwd / fwd+bwd vs unfused,
-#            speculative speedup + accept rate, int8 decode) and goodput
-#            fraction vs the last committed BENCH_kernels_*.json
+#            speculative speedup + accept rate, int8 decode) PLUS the
+#            quantized-training A/B (int8 vs bf16 steps/s through the real
+#            TrainValStage, loss-trajectory pass/fail) and goodput
+#            fraction vs EVERY committed BENCH_kernels_*.json and
+#            BENCH_train_*.json merged into one baseline — a fresh run
+#            measures BOTH children, so a vanished train_int8_* key FAILS
 #   elastic  the preemption drill (SIGTERM mid-epoch on 4 devices, resume
 #            on 2) vs the last committed BENCH_elastic_*.json — exact
 #            resume (0 replayed steps), save-on-preempt latency,
 #            time-to-resume; a missing metric FAILS
 #   serve    the continuous-batching serving A/B (Poisson trace, engine vs
-#            serial generate, the spec arm, the prefix-cache arm, the
-#            chaos arm, the multi-replica router drill) vs EVERY
-#            committed BENCH_serve_*.json merged into one baseline (each
-#            key at its most recently committed value) — tokens/s speedup,
-#            p99 TTFT, serve_spec_* accept/speedup keys, serve_prefix_*
-#            warm-TTFT / hit-rate keys, serve_chaos_* robustness keys,
-#            serve_router_* failover/drain keys (latencies lower-is-better;
-#            every receipt's keys stay enforced, missing metric = FAIL)
+#            serial generate, the spec arm, the Medusa arm, the
+#            prefix-cache arm, the chaos arm, the multi-replica router
+#            drill) vs EVERY committed BENCH_serve_*.json merged into one
+#            baseline (each key at its most recently committed value) —
+#            tokens/s speedup, p99 TTFT, serve_spec_* accept/speedup keys,
+#            serve_medusa_* speedup / zero-draft-blocks keys,
+#            serve_prefix_* warm-TTFT / hit-rate keys, serve_chaos_*
+#            robustness keys, serve_router_* failover/drain keys
+#            (latencies lower-is-better; every receipt's keys stay
+#            enforced, missing metric = FAIL)
 #   data     the streaming packed data plane A/B (mix -> pack_stream vs
 #            pad-to-max on the pinned ragged corpus) vs the last committed
 #            BENCH_data_*.json — packed tokens/s speedup, padding waste
 #            reclaimed, 0 mid-run recompiles, data_wait_s (lower-is-better)
+#   tier1    (opt-in: --suite tier1; NOT part of --suite all, CI runs the
+#            test suite separately) the tier-1 pytest suite wall time vs
+#            the last committed BENCH_tier1_*.json — tier1_suite_wall_s
+#            lower-is-better, tier1_exit_ok pass/fail
+#
+# Baselines recorded on a DIFFERENT host print a WARNING naming the
+# absolute keys (_per_sec/_s) whose floors may not transfer; ratio keys
+# are compared regardless.
 #
 # Runs after the lint gate in the CI flow:
 #

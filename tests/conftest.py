@@ -21,6 +21,13 @@ jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 import pytest  # noqa: E402
 
+# NOTE: do NOT arm the persistent XLA compilation cache (compile/cache.py)
+# globally here, tempting as it is for the engine-heavy serve tests: on
+# this jax/XLA:CPU, cache-deserialized executables destabilize the live
+# 8-device collective programs later in the suite (segfault in
+# test_resume's pipeline run — same failure family as the known
+# jax.clear_caches() hazard, see CHANGES.md PR 3).
+
 from dmlcloud_tpu.parallel import runtime  # noqa: E402
 
 
@@ -39,3 +46,83 @@ def mesh8():
 
     assert len(jax.devices()) == 8, "conftest must run before backend init"
     return mesh_lib.create_mesh({"data": -1})
+
+
+# ---------------------------------------------------------------------------
+# Session-scoped model fixtures (ROADMAP item 5c: tier-1 wall-time budget).
+#
+# test_serve, test_serve_router, test_speculative and test_quant each used
+# to init their own per-module copy of the same tiny LMs; building each
+# exactly ONCE per session removes the redundant inits and the re-traced
+# init programs from the suite's wall clock. All consumers treat params as
+# immutable (engines copy into pools, LoRA builds new trees), so sharing
+# one instance across files is safe.
+# ---------------------------------------------------------------------------
+
+
+def _init_lm(cfg_kw, seed, init_len=4):
+    import jax.numpy as jnp
+
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    cfg = TransformerConfig(dtype=jnp.float32, **cfg_kw)
+    model = DecoderLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.ones((1, init_len), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="session")
+def tiny_model():
+    """The 61-vocab fp32 serve model shared by test_serve/test_serve_router:
+    exact arithmetic so token-identity assertions are bitwise-ish."""
+    return _init_lm(
+        dict(vocab_size=61, num_layers=2, num_heads=4, num_kv_heads=2,
+             head_dim=8, hidden_dim=32, mlp_dim=64, max_seq_len=64),
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="session")
+def spec_models():
+    """Target (2-layer) + independent random draft (1-layer) pair for the
+    speculative-decoding exactness suite (test_speculative)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    def lm(layers, seed):
+        cfg = TransformerConfig(
+            vocab_size=48, num_layers=layers, num_heads=2, num_kv_heads=1,
+            head_dim=8, hidden_dim=16, mlp_dim=32, max_seq_len=96,
+            dtype=jnp.float32,
+        )
+        model = DecoderLM(cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 48, (1, 8)), jnp.int32
+        )
+        return model, model.init(jax.random.PRNGKey(seed), tokens)["params"]
+
+    target, tparams = lm(2, 0)
+    draft, dparams = lm(1, 7)
+    return target, tparams, draft, dparams
+
+
+@pytest.fixture(scope="session")
+def quant_lm():
+    """64-vocab LM for the weight-only int8 decode tests (test_quant)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=2, num_kv_heads=1, head_dim=8,
+        hidden_dim=16, mlp_dim=32, max_seq_len=48, dtype=jnp.float32,
+    )
+    model = DecoderLM(cfg)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params

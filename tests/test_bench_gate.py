@@ -752,3 +752,243 @@ def test_committed_data_receipt_satisfies_the_gate():
     # the boundary loss is reported and small relative to total padding
     pack = receipt["packed_stream"]["pack"]
     assert 0.0 <= pack["boundary_fraction"] <= pack["pad_fraction"]
+
+
+# ------------------------------------------- serve suite: Medusa decoding
+
+SERVE_MEDUSA_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "serve_tokens_per_sec_speedup": 3.0,
+        "serve_engine_tokens_per_sec": 300.0,
+        "serve_p99_ttft_s": 1.5,
+        "serve_medusa_speedup_vs_engine": 1.3,
+        "serve_medusa_accept_rate": 0.6,
+        "serve_medusa_tokens_per_sec": 390.0,
+        "serve_medusa_p99_ttft_s": 1.6,
+        "serve_medusa_token_identical": 1,
+        "serve_medusa_zero_recompiles": 1,
+        "serve_medusa_zero_draft_blocks": 1,
+    },
+}
+
+
+def test_serve_medusa_speedup_regression_fails(tmp_path, capsys):
+    """Medusa decode falling back under the plain engine's throughput
+    (speedup to ~1x) FAILS against the committed receipt."""
+    doctored = json.loads(json.dumps(SERVE_MEDUSA_RECEIPT))
+    doctored["gate"]["serve_medusa_speedup_vs_engine"] = 1.0
+    doctored["gate"]["serve_medusa_tokens_per_sec"] = 300.0
+    base = _write(tmp_path, "BENCH_serve_medusa_base.json", SERVE_MEDUSA_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "serve_medusa_speedup_vs_engine" in capsys.readouterr().out
+
+
+def test_serve_medusa_contracts_are_pass_fail(tmp_path, capsys):
+    """Token identity, zero recompiles AND the deleted-draft-pool contract
+    (zero draft blocks allocated, pool clean) ride the gate as 1/0 ints:
+    flipping any to 0 is a 100% drop — FAIL."""
+    for key in (
+        "serve_medusa_token_identical",
+        "serve_medusa_zero_recompiles",
+        "serve_medusa_zero_draft_blocks",
+    ):
+        doctored = json.loads(json.dumps(SERVE_MEDUSA_RECEIPT))
+        doctored["gate"][key] = 0
+        base = _write(tmp_path, f"BENCH_serve_{key}.json", SERVE_MEDUSA_RECEIPT)
+        assert run_gate(base, current=doctored) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_serve_medusa_missing_metric_fails(tmp_path, capsys):
+    """A medusa metric that silently vanishes from the current run (the
+    medusa arm stopped running at all) is a FAIL, not a pass."""
+    current = json.loads(json.dumps(SERVE_MEDUSA_RECEIPT))
+    del current["gate"]["serve_medusa_accept_rate"]
+    base = _write(tmp_path, "BENCH_serve_medusa_base.json", SERVE_MEDUSA_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_serve_medusa_p99_ttft_is_lower_is_better(tmp_path):
+    fast = json.loads(json.dumps(SERVE_MEDUSA_RECEIPT))
+    fast["gate"]["serve_medusa_p99_ttft_s"] = 0.2  # improvement: passes
+    base = _write(tmp_path, "BENCH_serve_medusa_base.json", SERVE_MEDUSA_RECEIPT)
+    assert run_gate(base, current=fast) == 0
+    slow = json.loads(json.dumps(SERVE_MEDUSA_RECEIPT))
+    slow["gate"]["serve_medusa_p99_ttft_s"] = 1.6 * 2.5  # > 2x: regression
+    assert run_gate(base, current=slow) == 1
+
+
+def test_committed_serve_medusa_receipt_satisfies_the_gate():
+    """The committed PR 16 receipt must pass its own gate and meet the
+    acceptance floors: medusa tokens/s at least the plain engine's, zero
+    draft-pool blocks allocated (the deleted second pool), survivors
+    token-identical to serial generate, zero mid-run recompiles inside a
+    budget STRICTLY below spec mode's."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_serve_medusa_pr16.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    assert gate["serve_medusa_speedup_vs_engine"] >= 1.0
+    assert gate["serve_medusa_token_identical"] == 1
+    assert gate["serve_medusa_zero_recompiles"] == 1
+    assert gate["serve_medusa_zero_draft_blocks"] == 1
+    medusa = receipt["medusa"]
+    assert medusa["medusa_engine"]["draft_pool_blocks"] == 0
+    assert medusa["medusa_engine"]["leaked_blocks"] == 0
+    assert medusa["medusa_engine"]["compiled_signatures"] <= medusa["medusa_engine"]["max_signatures"]
+    # the signature budget SHRANK vs spec mode — no draft signatures
+    assert medusa["max_signatures_vs_spec_mode"] < 0
+    # the spec-mode keys must still be present — medusa is a sibling mode,
+    # not a replacement (the pr10 contract stays enforced)
+    for key in ("serve_spec_speedup_vs_engine", "serve_spec_accept_rate"):
+        assert key in gate
+
+
+# ------------------------------------------ kernels suite: quantized training
+
+TRAIN_QUANT_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "train_int8_speedup_vs_bf16": 1.5,
+        "train_int8_steps_per_sec": 2.0,
+        "train_int8_tokens_per_sec": 1500.0,
+        "train_int8_loss_trajectory_ok": 1,
+    },
+}
+
+
+def test_train_quant_speedup_regression_fails(tmp_path, capsys):
+    """The int8 step sliding back to bf16 speed (speedup ~1x) FAILS
+    against the committed receipt."""
+    doctored = json.loads(json.dumps(TRAIN_QUANT_RECEIPT))
+    doctored["gate"]["train_int8_speedup_vs_bf16"] = 1.0
+    base = _write(tmp_path, "BENCH_train_quant_base.json", TRAIN_QUANT_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "train_int8_speedup_vs_bf16" in capsys.readouterr().out
+
+
+def test_train_quant_loss_trajectory_is_pass_fail(tmp_path, capsys):
+    """The loss-trajectory acceptance bound rides the gate as a 1/0 int: a
+    trajectory that diverges from the bf16 baseline flips it to 0 — FAIL."""
+    doctored = json.loads(json.dumps(TRAIN_QUANT_RECEIPT))
+    doctored["gate"]["train_int8_loss_trajectory_ok"] = 0
+    base = _write(tmp_path, "BENCH_train_quant_base.json", TRAIN_QUANT_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "train_int8_loss_trajectory_ok" in capsys.readouterr().out
+
+
+def test_train_quant_missing_metric_fails(tmp_path, capsys):
+    current = json.loads(json.dumps(TRAIN_QUANT_RECEIPT))
+    del current["gate"]["train_int8_speedup_vs_bf16"]
+    base = _write(tmp_path, "BENCH_train_quant_base.json", TRAIN_QUANT_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_kernels_suite_merges_train_receipts(tmp_path, monkeypatch):
+    """Without --baseline, the kernels suite folds BENCH_kernels_*.json AND
+    BENCH_train_*.json into one merged baseline: the train_int8_* keys stay
+    enforced (missing = FAIL) next to the kernel ratios."""
+    import bench as bench_mod
+
+    _write(tmp_path, "BENCH_kernels_a.json", RECEIPT)
+    _write(tmp_path, "BENCH_train_quant_b.json", TRAIN_QUANT_RECEIPT)
+    monkeypatch.setattr(
+        bench_mod.os.path, "dirname", lambda p, _real=bench_mod.os.path.dirname: str(tmp_path)
+    )
+    both = {"gate": {**RECEIPT["gate"], **TRAIN_QUANT_RECEIPT["gate"]}}
+    cur = _write(tmp_path, "cur.json", both)
+    assert gate_main(["--gate", "--suite", "kernels", "--current", cur]) == 0
+    # drop the train keys: the merged baseline still carries them — FAIL
+    partial = _write(tmp_path, "partial.json", {"gate": dict(RECEIPT["gate"])})
+    assert gate_main(["--gate", "--suite", "kernels", "--current", partial]) == 1
+
+
+def test_committed_train_quant_receipt_satisfies_the_gate():
+    """The committed PR 16 receipt must pass its own gate and meet the
+    acceptance floors: int8 steps/s >= 1.15x the bf16 baseline on the
+    pinned CPU-smoke config, with the loss trajectory inside the bound."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_train_quant_pr16.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    assert gate["train_int8_speedup_vs_bf16"] >= 1.15
+    assert gate["train_int8_loss_trajectory_ok"] == 1
+    assert receipt["loss_rel_gap_final_epoch"] <= receipt["config"]["loss_rel_bound"]
+    assert receipt["value_source"] == "cpu_smoke"
+    # both arms trained: per-epoch losses descend in both
+    assert receipt["bf16"]["epoch_losses"][-1] < receipt["bf16"]["epoch_losses"][0]
+    assert receipt["int8"]["epoch_losses"][-1] < receipt["int8"]["epoch_losses"][0]
+    # receipts carry their host fingerprint (cross-host floors warn)
+    assert receipt["host"]["cpu_count"] >= 1
+
+
+# ------------------------------------------------- host fingerprint warning
+
+
+def test_cross_host_baseline_warns_on_absolute_keys(tmp_path, capsys):
+    """A baseline recorded on a different box WARNS about its absolute
+    (non-ratio) keys — tokens/s floors don't transfer between hosts — but
+    does not fail the gate by itself."""
+    import bench as bench_mod
+
+    foreign = json.loads(json.dumps(TRAIN_QUANT_RECEIPT))
+    foreign["host"] = {"cpu_count": 999, "platform": "somewhere-else", "python": "3.10.0"}
+    base = _write(tmp_path, "BENCH_train_quant_foreign.json", foreign)
+    assert run_gate(base, current=dict(TRAIN_QUANT_RECEIPT)) == 0
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "different host" in err
+    assert "train_int8_tokens_per_sec" in err  # the absolute key is named
+    assert "train_int8_speedup_vs_bf16" not in err  # ratios are portable
+    # same host: silent
+    local = json.loads(json.dumps(TRAIN_QUANT_RECEIPT))
+    local["host"] = bench_mod._host_fingerprint()
+    base2 = _write(tmp_path, "BENCH_train_quant_local.json", local)
+    assert run_gate(base2, current=dict(TRAIN_QUANT_RECEIPT)) == 0
+    assert "WARNING" not in capsys.readouterr().err
+
+
+# -------------------------------------------------------- tier-1 wall suite
+
+TIER1_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {"tier1_suite_wall_s": 600.0, "tier1_exit_ok": 1},
+}
+
+
+def test_tier1_wall_is_lower_is_better(tmp_path):
+    """The suite wall time is a latency: getting faster passes, quietly
+    doubling past the latency tolerance FAILS before CI times out."""
+    base = _write(tmp_path, "BENCH_tier1_base.json", TIER1_RECEIPT)
+    fast = json.loads(json.dumps(TIER1_RECEIPT))
+    fast["gate"]["tier1_suite_wall_s"] = 300.0
+    assert run_gate(base, current=fast) == 0
+    slow = json.loads(json.dumps(TIER1_RECEIPT))
+    slow["gate"]["tier1_suite_wall_s"] = 600.0 * 2.5
+    assert run_gate(base, current=slow) == 1
+    broken = json.loads(json.dumps(TIER1_RECEIPT))
+    broken["gate"]["tier1_exit_ok"] = 0  # suite went red: pass/fail int
+    assert run_gate(base, current=broken) == 1
+
+
+def test_committed_tier1_receipt_satisfies_the_gate():
+    """The committed tier-1 budget receipt: green suite, wall time inside
+    the 870s CI budget."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import glob
+
+    receipts = sorted(glob.glob(os.path.join(here, "BENCH_tier1_*.json")))
+    if not receipts:
+        pytest.skip("receipt not committed yet")
+    receipt = json.load(open(receipts[-1]))
+    assert run_gate(receipts[-1], current=receipts[-1]) == 0
+    assert receipt["gate"]["tier1_exit_ok"] == 1
+    assert receipt["gate"]["tier1_suite_wall_s"] < 870.0

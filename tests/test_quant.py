@@ -52,24 +52,14 @@ def test_quantize_tree_matches_kernels_only():
     assert q_bytes < full_bytes  # int8 kernels beat bf16 kernels
 
 
-def _tiny_lm(vocab=64, s=48):
-    from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
-
-    cfg = TransformerConfig(
-        vocab_size=vocab, num_layers=2, num_heads=2, num_kv_heads=1, head_dim=8,
-        hidden_dim=16, mlp_dim=32, max_seq_len=s, dtype=jnp.float32,
-    )
-    model = DecoderLM(cfg)
-    tokens = jnp.asarray(np.random.RandomState(0).randint(0, vocab, (1, 8)), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
-    return model, params
+# quant_lm (the 64-vocab decode LM) comes from conftest.py, session-scoped.
 
 
 @pytest.mark.slow
-def test_quantized_generate_matches_shapes_and_tracks_full():
+def test_quantized_generate_matches_shapes_and_tracks_full(quant_lm):
     from dmlcloud_tpu.models.generate import generate
 
-    model, params = _tiny_lm()
+    model, params = quant_lm
     prompt = jnp.asarray(np.random.RandomState(1).randint(0, 64, (2, 8)), jnp.int32)
     full = np.asarray(generate(model, params, prompt, max_new_tokens=12))
     qparams = quantize_tree(params)
@@ -82,8 +72,8 @@ def test_quantized_generate_matches_shapes_and_tracks_full():
     assert agreement >= 0.75, (agreement, quant, full)
 
 
-def test_quantized_logits_close_to_full():
-    model, params = _tiny_lm()
+def test_quantized_logits_close_to_full(quant_lm):
+    model, params = quant_lm
     tokens = jnp.asarray(np.random.RandomState(2).randint(0, 64, (2, 16)), jnp.int32)
     full = np.asarray(model.apply({"params": params}, tokens))
     deq = dequant_tree(quantize_tree(params), jnp.float32)
@@ -92,7 +82,7 @@ def test_quantized_logits_close_to_full():
     assert np.abs(quant - full).max() / denom < 0.05
 
 
-def test_prepare_decode_params_is_exact_and_stays_quantized():
+def test_prepare_decode_params_is_exact_and_stays_quantized(quant_lm):
     """prepare_decode_params pre-pays the off-TPU GEMM-operand widen ONCE:
     kernels stay QuantizedTensor (scales still applied to the accumulator
     in the fused dot), q widens to fp32 exactly (int8 -> fp32 is lossless),
@@ -100,7 +90,7 @@ def test_prepare_decode_params_is_exact_and_stays_quantized():
     from dmlcloud_tpu.models.generate import generate
     from dmlcloud_tpu.models.quant import prepare_decode_params
 
-    model, params = _tiny_lm()
+    model, params = quant_lm
     qparams = quantize_tree(params)
     prepared = prepare_decode_params(qparams, jnp.float32)
 

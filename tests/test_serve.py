@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from dmlcloud_tpu.models.generate import decode_step, generate, init_cache
 from dmlcloud_tpu.models.lora import LoraPair, lora_init, lora_merge
+from dmlcloud_tpu.models.speculative import init_medusa_heads
 from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 from dmlcloud_tpu.ops.paged_attention import gather_pages, scatter_tokens
 from dmlcloud_tpu.serve import (
@@ -55,12 +56,8 @@ def _tiny_cfg(**kw):
     return TransformerConfig(**base)
 
 
-@pytest.fixture(scope="module")
-def tiny_model():
-    cfg = _tiny_cfg()
-    model = DecoderLM(cfg)
-    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))["params"]
-    return model, params
+# tiny_model (the shared 61-vocab serve LM) comes from conftest.py,
+# session-scoped: test_serve_router reuses the same instance.
 
 
 def _prompt(n, seed=0):
@@ -280,6 +277,7 @@ class TestEngineIdentity:
 
 
 class TestSchedulerProperties:
+    @pytest.mark.slow  # random-load property drill; per-step invariants also locked by the cheap FIFO/EOS unit tests
     def test_no_starvation_under_random_load(self, tiny_model):
         """30 random requests into 3 slots over a tight pool: every
         admitted request finishes, admissions are strict FIFO, the pool
@@ -321,6 +319,7 @@ class TestSchedulerProperties:
 
 
 class TestBucketing:
+    @pytest.mark.slow  # shape-churn property drill; the spec/medusa budget + warm-replay locks stay tier-1
     def test_churning_traffic_stays_inside_the_signature_budget(self, tiny_model):
         """Random churn (ragged prompts, ragged budgets, slots freeing and
         refilling) never compiles past max_signatures — TraceGuard is
@@ -386,6 +385,7 @@ class TestSpeculativeEngine:
         assert engine.pool.num_free == engine.pool.num_blocks
         assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
 
+    @pytest.mark.slow  # heavyweight random-draft drill; accept~0 identity also locked by the self-draft + eos round tests
     def test_partial_accepts_stay_token_identical(self, tiny_model, tiny_draft):
         """An independent random draft disagrees with the target almost
         everywhere — near-zero accept — yet greedy output must STILL be
@@ -407,6 +407,7 @@ class TestSpeculativeEngine:
             np.testing.assert_array_equal(out[rid], ref)
         assert engine.ledger.summary()["accept_rate"] < 0.5  # genuinely partial
 
+    @pytest.mark.slow  # random-load property drill over both pools
     def test_spec_random_load_invariants(self, tiny_model, tiny_draft):
         """The satellite property test: random spec-decode load with
         partial accepts — after EVERY engine step both pools hold
@@ -518,6 +519,7 @@ class TestSpeculativeEngine:
         assert s["mean_request_accept_rate"] == 1.0
         assert s["accepted_tokens"] == s["drafted_tokens"]
 
+    @pytest.mark.slow  # span-kind drill over a full spec run; journal emission locked by the cheap telemetry test
     def test_spec_journal_spans(self, tiny_model, tmp_path):
         from dmlcloud_tpu.telemetry import journal as journal_mod
 
@@ -588,6 +590,7 @@ class TestPerRequestSampling:
         assert seq.top_k == 7  # engine default inherited
         assert seq.eos_id == -1
 
+    @pytest.mark.slow  # mixed-sampling drill; greedy-row bit-identity and medusa mixed-sampling locks stay tier-1
     def test_spec_mixed_sampling_batch(self, tiny_model):
         """Per-row params flow through the spec verify step too: a greedy
         and a sampled row share a spec batch; the greedy row stays
@@ -639,6 +642,7 @@ class TestAdapterSet:
         out = engine.run()
         return [out[r] for r in rids]
 
+    @pytest.mark.slow  # heavyweight two-tenant drill; adapter math locked by the lora-merge/null-adapter units
     def test_two_tenants_in_one_batch_match_each_alone(self, tiny_model, adapters):
         _, _, aset = adapters
         both = self._run(tiny_model, aset, ["a", "b", None])
@@ -1011,6 +1015,7 @@ class TestPrefixEngine:
         np.testing.assert_array_equal(engine.output(r3), ref)
         assert engine.compiled_signatures() == before
 
+    @pytest.mark.slow  # eviction-pressure drill; eviction-race lock lives in the prefix-cache unit tests
     def test_identity_under_eviction_pressure(self, tiny_model):
         """A pool too small to cache every prompt: LRU leaves evict to
         admit new requests, and every output stays token-identical."""
@@ -1029,6 +1034,7 @@ class TestPrefixEngine:
         assert engine.prefix.stats()["evictions"] > 0  # pressure was real
         assert engine.pool.num_free + engine.pool.num_live == engine.pool.num_blocks
 
+    @pytest.mark.slow  # admission property drill under sharing
     def test_admission_property_under_sharing(self, tiny_model):
         """The satellite property test: random 80%-shared-template load
         through a TIGHT pool with shared blocks discounted from
@@ -1083,6 +1089,7 @@ class TestPrefixEngine:
                 assert engine.compiled_signatures() == before
         assert engine.compiled_signatures() <= engine.max_signatures
 
+    @pytest.mark.slow  # engine-level tenant-isolation drill; the prefix-cache unit tests lock adapter namespacing
     def test_prefix_never_crosses_adapter_tenants(self, tiny_model):
         """Two tenants sending the SAME prompt must not share K/V: the
         adapter id namespaces the radix tree, so each tenant's output
@@ -1159,6 +1166,7 @@ class TestSpecPrefixCompose:
         assert engine.pool.num_free + engine.pool.num_live == engine.pool.num_blocks
         assert engine.draft_pool.num_free == engine.draft_pool.num_blocks
 
+    @pytest.mark.slow  # warm-replay drill; spec x prefix identity kept tier-1 via the independent-draft test
     def test_spec_prefix_self_draft_warm_replay(self, tiny_model):
         """Self-draft + prefix: warm template requests stay
         token-identical, and the draft pool (no tree) never leaks."""
@@ -1203,6 +1211,7 @@ class TestSpecLora:
         assert s["drafted_tokens"] > 0
         assert engine.ledger.accept_rate(rb) == 1.0
 
+    @pytest.mark.slow  # mixed-tenant spec x LoRA drill; the all-compose lock stays tier-1
     def test_spec_lora_mixed_tenants_one_batch(self, tiny_model):
         """Two adapted tenants + base in ONE spec batch decode exactly
         what each decodes alone — no cross-row contamination through the
@@ -1249,6 +1258,193 @@ class TestSpecLora:
         np.testing.assert_array_equal(engine.output(r3), ref3)
         assert engine.ledger.records[r2]["cached_tokens"] == 12  # tenant-a warm hit
         assert engine.ledger.records[r3]["cached_tokens"] == 0  # namespaced
+
+
+# ---------------------------------------------------------------------------
+# Medusa mode: draftless speculation off the target's own hidden state (PR 16)
+# ---------------------------------------------------------------------------
+
+
+class TestMedusaEngine:
+    """``medusa_k``: up to k tokens per round from lightweight extra decode
+    heads on the target's last hidden state — ONE model, ONE block pool,
+    ONE k-position forward per round (the next round's proposals ride the
+    current round's packed fetch). Same acceptance contract as spec mode
+    (greedy survivors token-identical to serial generate), none of the
+    draft model's memory."""
+
+    def test_medusa_k1_identity_degenerates_to_plain_decode(self, tiny_model):
+        """k=1 has no heads: every round is one 1-position forward through
+        the medusa signature — exactly plain decode (nothing drafted, so
+        the accept-rate observable is undefined), token-identical to
+        serial generate."""
+        model, params = tiny_model
+        specs = [(7, 6), (13, 4), (5, 9), (22, 5)]
+        engine = _engine(model, params, medusa_k=1)
+        assert engine.draft_pool is None  # the deleted second pool
+        rids = [engine.submit(_prompt(n, seed=i), m) for i, (n, m) in enumerate(specs)]
+        out = engine.run(max_steps=5000)
+        for rid, (n, m) in zip(rids, specs):
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(n, seed=rid))[None], m)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+        s = engine.ledger.summary()
+        assert s["accept_rate"] is None
+        assert s["drafted_tokens"] == 0
+        assert engine.pool.num_free == engine.pool.num_blocks
+
+    def test_medusa_random_heads_stay_token_identical(self, tiny_model):
+        """Untrained random heads propose near-garbage — accept collapses
+        toward zero — yet greedy output must STILL be token-identical:
+        rejected proposals leave stale K/V that the fill-counter rewind
+        must fully hide (the spec-mode contract, same verifier)."""
+        model, params = tiny_model
+        # no lm_head warm start: w2 is small random noise, proposals from
+        # heads 1..k-1 are unrelated to the target's argmax
+        heads = init_medusa_heads(model.cfg, 4, jax.random.PRNGKey(7))
+        engine = _engine(model, params, max_slots=3, medusa_k=4, medusa_heads=heads)
+        specs = [(7, 6), (13, 4), (5, 9), (22, 5), (3, 8)]
+        rids = [engine.submit(_prompt(n, seed=i), m) for i, (n, m) in enumerate(specs)]
+        out = engine.run(max_steps=5000)
+        for rid, (n, m) in zip(rids, specs):
+            ref = np.asarray(
+                generate(model, params, jnp.asarray(_prompt(n, seed=rid))[None], m)
+            )[0]
+            np.testing.assert_array_equal(out[rid], ref)
+        s = engine.ledger.summary()
+        assert s["drafted_tokens"] > 0  # heads genuinely proposed
+        assert s["accept_rate"] < 0.5  # ... and the garbage mostly rejected
+
+    def test_medusa_warm_start_heads_accept_high_on_repetitive_chain(
+        self, tiny_model
+    ):
+        """The accept≈1 end of the contract: lm_head-warm-started heads
+        predict "the correction token repeats" — on a greedy chain that
+        HAS entered its repeating cycle, that is mostly right, so accept
+        climbs toward 1 while output stays token-identical (the identity
+        proof must not depend on accept being low)."""
+        model, params = tiny_model
+        # walk the chain INTO its fixed point first: this model's greedy
+        # continuation of _prompt(4) goes constant after ~18 tokens, so a
+        # prompt extended by that warmup decodes entirely inside the cycle
+        seed_prompt = _prompt(4, seed=0)
+        warm = np.asarray(
+            generate(model, params, jnp.asarray(seed_prompt)[None], 18)
+        )[0]
+        prompt = np.concatenate([seed_prompt, warm]).astype(np.int32)
+        engine = _engine(model, params, medusa_k=3, num_blocks=48)
+        rid = engine.submit(prompt, 36)
+        out = engine.run(max_steps=5000)
+        ref = np.asarray(generate(model, params, jnp.asarray(prompt)[None], 36))[0]
+        np.testing.assert_array_equal(out[rid], ref)
+        assert engine.ledger.summary()["accept_rate"] > 0.8
+
+    @pytest.mark.slow  # random-load property drill; medusa identity/budget/compose locks stay tier-1
+    def test_medusa_random_load_pool_invariants_per_step(self, tiny_model):
+        """The drill property: random Medusa load — after EVERY engine step
+        the single pool's ``stats()`` balance holds, ``leaked_blocks()`` is
+        zero, and there is never a draft pool. FIFO + starvation-freedom +
+        pristine drain, as in spec mode."""
+        model, params = tiny_model
+        rs = np.random.RandomState(13)
+        engine = ServeEngine(
+            model, params, num_blocks=28, block_size=4, max_slots=3,
+            prefill_chunk=8, medusa_k=3,
+        )
+        specs = [(int(rs.randint(1, 18)), int(rs.randint(1, 8))) for _ in range(24)]
+        rids = [
+            engine.submit(_prompt(n, seed=300 + i), m) for i, (n, m) in enumerate(specs)
+        ]
+        steps = 0
+        while not engine.idle and steps < 5000:
+            engine.step()
+            steps += 1
+            st = engine.pool.stats()
+            assert st["free"] + st["live"] == st["capacity"]
+            assert engine.draft_pool is None
+            if engine.idle:  # leak audit is defined at idle (in-flight != leak)
+                assert engine.leaked_blocks() == 0
+        assert engine.leaked_blocks() == 0
+        out = engine.results()
+        assert sorted(out) == sorted(rids), "an admitted request starved"
+        for rid, (_, m) in zip(rids, specs):
+            assert len(out[rid]) == m
+        assert engine.pool.num_free == engine.pool.num_blocks
+        admits = [engine.ledger.records[r]["admitted"] for r in rids]
+        assert admits == sorted(admits)  # strict FIFO held
+
+    def test_medusa_signature_budget_and_warm_replay(self, tiny_model):
+        """Churning Medusa traffic stays inside its TraceGuard budget —
+        which is SMALLER than spec mode's (no draft signatures, no second
+        prefill mirror) — and a warm engine replaying the same shapes
+        compiles NOTHING new."""
+        model, params = tiny_model
+        engine = _engine(model, params, max_slots=4, medusa_k=3, guard="raise")
+        spec_engine = _engine(model, params, max_slots=4, spec_k=3)
+        assert engine.max_signatures < spec_engine.max_signatures
+        specs = [(5 + 3 * (i % 4), 3 + (i % 3)) for i in range(8)]
+        for wave, assert_warm in ((0, False), (1, True)):
+            before = engine.compiled_signatures()
+            for i, (n, m) in enumerate(specs):
+                engine.submit(_prompt(n, seed=100 * wave + i), m)
+            engine.run(max_steps=5000)
+            if assert_warm:
+                assert engine.compiled_signatures() == before
+        assert engine.compiled_signatures() <= engine.max_signatures
+
+    def test_medusa_mixed_sampling_batch(self, tiny_model):
+        """Per-request sampling params ride the Medusa round too: a greedy
+        and a sampled row share a batch; the greedy row stays identical to
+        serial generate, the sampled row stays in-vocab."""
+        model, params = tiny_model
+        engine = _engine(model, params, medusa_k=3)
+        r_g = engine.submit(_prompt(8, seed=1), 6)
+        r_s = engine.submit(_prompt(8, seed=2), 6, temperature=1.1)
+        out = engine.run(max_steps=2000)
+        ref = np.asarray(generate(model, params, jnp.asarray(_prompt(8, seed=1))[None], 6))[0]
+        np.testing.assert_array_equal(out[r_g], ref)
+        assert ((out[r_s] >= 0) & (out[r_s] < model.cfg.vocab_size)).all()
+
+    def test_medusa_lora_prefix_all_compose(self, tiny_model):
+        """All three: Medusa x LoRA x prefix cache (the Medusa mirror of
+        ``TestSpecLora.test_spec_lora_prefix_all_compose``). The heads
+        propose off the ADAPTED hidden state, verification is adapter-
+        aware, sharing stays tenant-namespaced — and the output is still
+        exactly the merged model's."""
+        model, params = tiny_model
+        ad = _randomized_adapter(params, 1, 10)
+        aset = AdapterSet({"a": ad}, alpha=4.0, base=params)
+        engine = _engine(
+            model, params, max_slots=1, medusa_k=2, adapters=aset, prefix_cache=True
+        )
+        tmpl = _prompt(12, seed=55)
+        p1 = _template_prompt(tmpl, 3, 56)
+        p2 = _template_prompt(tmpl, 4, 57)
+        r1 = engine.submit(p1, 5, adapter="a")
+        r2 = engine.submit(p2, 5, adapter="a")
+        r3 = engine.submit(p2, 5)  # base tenant: must not hit "a"'s blocks
+        engine.run(max_steps=4000)
+        merged = lora_merge(params, ad, alpha=4.0)
+        for rid, p in ((r1, p1), (r2, p2)):
+            ref = np.asarray(generate(model, merged, jnp.asarray(p)[None], 5))[0]
+            np.testing.assert_array_equal(engine.output(rid), ref)
+        ref3 = np.asarray(generate(model, params, jnp.asarray(p2)[None], 5))[0]
+        np.testing.assert_array_equal(engine.output(r3), ref3)
+        assert engine.ledger.records[r2]["cached_tokens"] == 12  # tenant-a warm hit
+        assert engine.ledger.records[r3]["cached_tokens"] == 0  # namespaced
+        assert engine.draft_pool is None
+        assert engine.leaked_blocks() == 0
+
+    def test_medusa_rejects_bad_args(self, tiny_model):
+        model, params = tiny_model
+        with pytest.raises(ValueError, match="medusa_k"):
+            _engine(model, params, medusa_k=-1)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            _engine(model, params, spec_k=2, medusa_k=2)
+        with pytest.raises(ValueError, match="medusa_heads"):
+            heads = init_medusa_heads(model.cfg, 2, jax.random.PRNGKey(0))
+            _engine(model, params, medusa_heads=heads)
 
 
 # ---------------------------------------------------------------------------
@@ -1324,6 +1520,7 @@ class TestRequestLifecycle:
         with pytest.raises(ValueError, match="deadline_s"):
             engine.submit(_prompt(4), 4, deadline_s=0.0)
 
+    @pytest.mark.slow  # random cancel/expiry property drill; lifecycle units cover each terminal path
     def test_random_cancel_and_expiry_property(self, tiny_model):
         """The lifecycle property test: random cancels (seeded monkey) and
         random deadlines injected over random load — every request ends
@@ -1480,6 +1677,7 @@ class TestChaosDrill:
         for r, rr in survivors:
             np.testing.assert_array_equal(engine.output(r), ref_out[rr])
 
+    @pytest.mark.slow  # replays the seeded drill twice; the single-run contract lock stays tier-1
     def test_drill_is_replayable(self, tiny_model):
         """Same seed, same trace -> same injected events and same terminal
         census: the drill is a deterministic regression test, not a fuzzer."""
@@ -1587,6 +1785,7 @@ class TestSpecChaos:
             )[0]
             np.testing.assert_array_equal(out[rid], ref)
 
+    @pytest.mark.slow  # verify-fault drill; draft-fault degrade/resume + step-fault isolation locks stay tier-1
     def test_verify_fault_errors_only_its_batch(self, tiny_model):
         """A verify failure is a REAL step failure: exactly the rows in
         that round error; requests outside the batch finish ok and both
@@ -1775,6 +1974,7 @@ class TestLedgerRetention:
 
 
 class TestFailedAdmitChaos:
+    @pytest.mark.slow  # failed-admit x chaos property drill
     def test_failed_admits_interleaved_with_chaos(self, tiny_model):
         """Submissions that FAIL validation (oversized prompts) interleave
         with shed arrivals, injected faults and pool squats — failed

@@ -45,11 +45,7 @@ import types
 import numpy as np
 import pytest
 
-import jax
-import jax.numpy as jnp
-
 from dmlcloud_tpu.checkpoint import read_requeue_verdict
-from dmlcloud_tpu.models.transformer import DecoderLM, TransformerConfig
 from dmlcloud_tpu.serve import (
     ChaosMonkey,
     DuplicateRequest,
@@ -539,22 +535,8 @@ class TestLedgerTenantPercentiles:
 # ---------------------------------------------------------------------------
 
 
-def _tiny_cfg(**kw):
-    base = dict(
-        vocab_size=61, num_layers=2, num_heads=4, num_kv_heads=2,
-        head_dim=8, hidden_dim=32, mlp_dim=64, max_seq_len=64,
-        dtype=jnp.float32,
-    )
-    base.update(kw)
-    return TransformerConfig(**base)
-
-
-@pytest.fixture(scope="module")
-def tiny_model():
-    cfg = _tiny_cfg()
-    model = DecoderLM(cfg)
-    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))["params"]
-    return model, params
+# tiny_model (the shared 61-vocab serve LM) comes from conftest.py,
+# session-scoped: the same instance test_serve uses.
 
 
 def _prompt(n, seed=0):
@@ -632,6 +614,7 @@ class _DrillChaos:
 
 
 class TestFailoverProperty:
+    @pytest.mark.slow  # random replica-chaos property drill; the seeded kill+drain integration lock stays tier-1
     def test_random_replica_chaos_under_tight_pool(self, tiny_model, tmp_path):
         model, params = tiny_model
         n_req = 10

@@ -26,15 +26,12 @@ def _lm(layers, seed, vocab=48, s=96):
     return model, params
 
 
-@pytest.fixture(scope="module")
-def models():
-    target, tparams = _lm(layers=2, seed=0)
-    draft, dparams = _lm(layers=1, seed=7)
-    return target, tparams, draft, dparams
+# spec_models (target + independent draft) comes from conftest.py,
+# session-scoped: built once for the whole suite.
 
 
-def test_random_draft_matches_plain_greedy(models):
-    target, tparams, draft, dparams = models
+def test_random_draft_matches_plain_greedy(spec_models):
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.asarray(np.random.RandomState(1).randint(0, 48, (3, 10)), jnp.int32)
     want = np.asarray(generate(target, tparams, prompt, max_new_tokens=20))
     got = np.asarray(
@@ -43,8 +40,8 @@ def test_random_draft_matches_plain_greedy(models):
     np.testing.assert_array_equal(got, want)
 
 
-def test_perfect_draft_matches_plain_greedy(models):
-    target, tparams, _, _ = models
+def test_perfect_draft_matches_plain_greedy(spec_models):
+    target, tparams, _, _ = spec_models
     prompt = jnp.asarray(np.random.RandomState(2).randint(0, 48, (2, 6)), jnp.int32)
     want = np.asarray(generate(target, tparams, prompt, max_new_tokens=16))
     got = np.asarray(
@@ -54,8 +51,8 @@ def test_perfect_draft_matches_plain_greedy(models):
 
 
 @pytest.mark.parametrize("k", [1, 2, 5])
-def test_k_values_all_exact(models, k):
-    target, tparams, draft, dparams = models
+def test_k_values_all_exact(spec_models, k):
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.asarray(np.random.RandomState(3).randint(0, 48, (2, 7)), jnp.int32)
     want = np.asarray(generate(target, tparams, prompt, max_new_tokens=15))
     got = np.asarray(
@@ -64,8 +61,8 @@ def test_k_values_all_exact(models, k):
     np.testing.assert_array_equal(got, want)
 
 
-def test_eos_early_exit_matches(models):
-    target, tparams, draft, dparams = models
+def test_eos_early_exit_matches(spec_models):
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.asarray(np.random.RandomState(4).randint(0, 48, (2, 6)), jnp.int32)
     # find an eos id that actually occurs early in the greedy output so the
     # early-exit path is exercised rather than vacuously skipped
@@ -80,12 +77,12 @@ def test_eos_early_exit_matches(models):
     np.testing.assert_array_equal(got, want)
 
 
-def test_sliding_window_target_matches(models):
+def test_sliding_window_target_matches(spec_models):
     """The target's windowed decode mask must hold under the verify pass's
     multi-token dynamic-offset reads too."""
     import dataclasses
 
-    _, _, draft, dparams = models
+    _, _, draft, dparams = spec_models
     cfg = dataclasses.replace(_lm(2, 0)[0].cfg, sliding_window=8)
     target = DecoderLM(cfg)
     tparams = target.init(
@@ -99,10 +96,10 @@ def test_sliding_window_target_matches(models):
     np.testing.assert_array_equal(got, want)
 
 
-def test_quantized_target_runs(models):
+def test_quantized_target_runs(spec_models):
     from dmlcloud_tpu.models.quant import quantize_tree
 
-    target, tparams, draft, dparams = models
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.asarray(np.random.RandomState(5).randint(0, 48, (1, 8)), jnp.int32)
     got = np.asarray(
         speculative_generate(
@@ -112,8 +109,8 @@ def test_quantized_target_runs(models):
     assert got.shape == (1, 8)
 
 
-def test_sampled_mode_runs_and_is_deterministic_per_key(models):
-    target, tparams, draft, dparams = models
+def test_sampled_mode_runs_and_is_deterministic_per_key(spec_models):
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.asarray(np.random.RandomState(7).randint(0, 48, (2, 6)), jnp.int32)
     a = np.asarray(
         speculative_generate(
@@ -138,7 +135,7 @@ def test_sampled_mode_runs_and_is_deterministic_per_key(models):
     assert a.shape == (2, 10) and (a >= 0).all() and (a < 48).all()
 
 
-def test_sampled_distribution_matches_target_sampling(models):
+def test_sampled_distribution_matches_target_sampling(spec_models):
     """The rejection-sampling guarantee: speculative sampling with a
     DIFFERENT draft must be distributed like target-only sampling. Check
     the second generated token's marginal (the first comes from prefill
@@ -171,11 +168,11 @@ def test_sampled_distribution_matches_target_sampling(models):
         assert tv < 0.12, (pos, tv, p_spec, p_plain)
 
 
-def test_ragged_prompts_match_plain_greedy(models):
+def test_ragged_prompts_match_plain_greedy(spec_models):
     """LEFT-padded ragged prompts decode exactly as plain generate's
     ragged path — pad slots masked, positions counted from each row's
     first real token."""
-    target, tparams, draft, dparams = models
+    target, tparams, draft, dparams = spec_models
     rng = np.random.RandomState(8)
     width = 10
     prompt = rng.randint(1, 48, (3, width)).astype(np.int32)
@@ -196,19 +193,19 @@ def test_ragged_prompts_match_plain_greedy(models):
     np.testing.assert_array_equal(got, want)
 
 
-def test_length_guard(models):
-    target, tparams, draft, dparams = models
+def test_length_guard(spec_models):
+    target, tparams, draft, dparams = spec_models
     prompt = jnp.zeros((1, 90), jnp.int32)
     with pytest.raises(ValueError, match="max_seq_len"):
         speculative_generate(target, tparams, draft, dparams, prompt, max_new_tokens=10, k=4)
 
 
-def test_return_stats_consistency(models):
+def test_return_stats_consistency(spec_models):
     """rounds/generated must obey the accept-rate algebra: every round emits
     between 1 and k+1 tokens (so rounds bounds generated-1 from both sides),
     a perfect draft needs the fewest rounds, and the derived accept rate for
     the SAME-model draft is exactly 1."""
-    target, tparams, draft, dparams = models
+    target, tparams, draft, dparams = spec_models
     k = 3
     prompt = jnp.asarray(np.random.RandomState(5).randint(0, 48, (3, 9)), jnp.int32)
     toks, (rounds, generated, accepted) = speculative_generate(
@@ -281,12 +278,12 @@ def _np_reference_counters(target, tparams, draft, dparams, prompt_row, max_new,
     return rounds, pos - t, accepted
 
 
-def test_accept_counter_matches_numpy_reference(models):
+def test_accept_counter_matches_numpy_reference(spec_models):
     """The on-device rounds/advanced/accepted counters must be EXACT —
     equal to a from-scratch NumPy reference of the greedy round structure,
     row by row (the r01-r05 receipts recorded accept 0.0 because the
     observable was never pinned to an independent implementation)."""
-    target, tparams, draft, dparams = models
+    target, tparams, draft, dparams = spec_models
     k, max_new = 3, 14
     prompt = jnp.asarray(np.random.RandomState(11).randint(0, 48, (3, 8)), jnp.int32)
     _, (rounds, advanced, accepted) = speculative_generate(
@@ -301,12 +298,12 @@ def test_accept_counter_matches_numpy_reference(models):
         assert got == want, f"row {row}: device counters {got} != numpy reference {want}"
 
 
-def test_rewound_cache_bit_identical_at_accepted_prefix(models):
+def test_rewound_cache_bit_identical_at_accepted_prefix(spec_models):
     """return_cache=True caches are rewound with ONE masked-select primitive:
     the stale speculative tail must be exactly zero, and the valid prefix
     must be bit-identical across runs with DIFFERENT drafts (different
     rejection patterns, different stale slots — same greedy tokens)."""
-    target, tparams, draft, dparams = models
+    target, tparams, draft, dparams = spec_models
     k, max_new = 3, 12
     prompt = jnp.asarray(np.random.RandomState(12).randint(0, 48, (2, 7)), jnp.int32)
     t = prompt.shape[1]
