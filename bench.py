@@ -2312,6 +2312,8 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "serve_router_hot_tenant_cold_p99_ttft_s",
         "data_wait_s",
         "tier1_suite_wall_s",
+        "lint_cold_wall_s",
+        "lint_warm_wall_s",
     }
 )
 
@@ -2487,7 +2489,10 @@ def gate_main(argv: list) -> int:
     reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better
     latency); the ``tier1`` suite (opt-in, not part of ``all``) times the
     tier-1 pytest run and gates its wall seconds lower-is-better against
-    the last ``BENCH_tier1_*.json``. A missing metric FAILS in every
+    the last ``BENCH_tier1_*.json``; the ``lint`` suite (also opt-in) runs
+    the incremental-cache cold/warm A/B (scripts/bench_lint.py) and gates
+    both wall times plus the ``lint_incremental_ok`` warm-budget bit
+    against the last ``BENCH_lint_*.json``. A missing metric FAILS in every
     suite; ``all`` chains them and fails on the worst. Baselines recorded
     on a different host WARN about their absolute (non-ratio) keys."""
 
@@ -2500,9 +2505,9 @@ def gate_main(argv: list) -> int:
 
     suite = _opt("--suite", "kernels")
     tolerance = float(_opt("--tolerance", _GATE_TOLERANCE))
-    if suite not in ("kernels", "elastic", "serve", "data", "tier1", "all"):
+    if suite not in ("kernels", "elastic", "serve", "data", "tier1", "lint", "all"):
         print(
-            f"gate: unknown --suite {suite!r} (kernels|elastic|serve|data|tier1|all)",
+            f"gate: unknown --suite {suite!r} (kernels|elastic|serve|data|tier1|lint|all)",
             file=sys.stderr,
         )
         return 2
@@ -2637,7 +2642,55 @@ def gate_main(argv: list) -> int:
                 print("gate: FAIL — tier-1 suite child produced no results", file=sys.stderr)
                 return 2
         rcs.append(run_gate(baseline, current, tolerance))
+    if suite == "lint":
+        # NOT part of --suite all (CI's lint_gate.sh already runs the
+        # linter on every invocation): cold-vs-warm A/B of the incremental
+        # lint cache against the last committed BENCH_lint_pr17-style
+        # receipt. The child refuses to emit a receipt if the warm run
+        # changes the findings, and stamps lint_incremental_ok=0 when warm
+        # exceeds its budget fraction of cold — either FAILS here (a
+        # vanished metric fails too, like every other suite).
+        baseline = _opt("--baseline") or _latest_receipt("lint")
+        if baseline is None:
+            print("gate: FAIL — no --baseline and no committed BENCH_lint_*.json", file=sys.stderr)
+            return 2
+        current = _opt("--current")
+        if current is None:
+            print("gate: running the lint cold/warm A/B (bench_lint child)...", file=sys.stderr)
+            current = bench_lint()
+            if current is None:
+                print("gate: FAIL — lint bench child produced no results", file=sys.stderr)
+                return 2
+        rcs.append(run_gate(baseline, current, tolerance))
     return max(rcs)
+
+
+def bench_lint(timeout_s: int = 300) -> dict | None:
+    """Run scripts/bench_lint.py (pure-stdlib child — the linter must stay
+    importable without jax) and return its receipt dict: cold/warm wall
+    seconds of the self-lint plus the ``lint_incremental_ok`` bit. None if
+    the child failed or produced no receipt."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "lint_receipt.json")
+        cmd = [sys.executable, os.path.join(here, "scripts", "bench_lint.py"), "-o", out]
+        try:
+            proc = subprocess.run(
+                cmd, cwd=here, timeout=timeout_s,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr or "")
+            return None
+        try:
+            with open(out) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
 
 def bench_tier1(timeout_s: int = 870) -> dict | None:

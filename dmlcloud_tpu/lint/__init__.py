@@ -35,7 +35,23 @@ DML206      ``lax.scan``/``nn.scan`` over a layer stack without a remat
             policy — activation memory grows with depth
 DML301      shared attribute locked on one side of a thread boundary only
 DML302      ``time.sleep`` polling loop where an Event/Condition exists
+DML501      ``KVBlockPool.alloc``/``PrefixCache.lock`` reference leaked on
+            some path out of the owning scope (whole-program, path- and
+            helper-aware — subsumes the DML212 identifier heuristic)
+DML502      paged ``scatter_tokens`` write reachable without a preceding
+            COW guard/fork, across modules and import renames (upgrades
+            DML211 from vocabulary scoping to resolved references)
+DML503      terminate/finalize-family path exiting with zero or 2+
+            ``TERMINAL_STATUSES`` stamps — the single-exit contract
+DML504      DML301's lockset check across module boundaries: thread-target
+            closures through helpers and inherited methods
 ==========  ============================================================
+
+DML5xx run in the whole-program pass of ``lint_paths`` (lint/callgraph.py
+summaries + lint/lifecycle.py rules; ``--no-callgraph`` disables). The
+incremental cache (lint/cache.py, ``--cache``) re-lints only changed
+files and their reverse importers; ``--fix`` applies the mechanical
+repairs in lint/fix.py.
 
 Entry points: ``lint_source``/``lint_file``/``lint_paths`` (library),
 ``python -m dmlcloud_tpu lint`` (CLI; ``--format=github``, ``--jobs N``),
@@ -51,6 +67,7 @@ with bad/good examples: doc/lint.md.
 from .engine import (  # noqa: F401
     Finding,
     LintError,
+    PROJECT_RULES,
     RULES,
     build_project_context,
     lint_file,
@@ -62,20 +79,32 @@ from . import rules_sharding  # noqa: F401  — DML2xx sharding/collective famil
 from . import rules_perf  # noqa: F401  — DML205/206 donation & remat contracts
 from . import rules_data  # noqa: F401  — DML209 packed segment_ids contract
 from . import rules_concurrency  # noqa: F401  — DML3xx concurrency family
+from . import lifecycle  # noqa: F401  — DML5xx whole-program lifecycle family
+from .cache import DEFAULT_CACHE_PATH, LintCache  # noqa: F401
+from .callgraph import ProjectGraph, summarize_module  # noqa: F401
+from .fix import FIXABLE_RULES, apply_fixes, apply_suppressions  # noqa: F401
 from .sanitize import SANITIZE_MODES, Sanitizer, SanitizerError  # noqa: F401
 from .traceguard import RetraceError, TraceGuard  # noqa: F401
 
 __all__ = [
+    "DEFAULT_CACHE_PATH",
+    "FIXABLE_RULES",
     "Finding",
+    "LintCache",
     "LintError",
+    "PROJECT_RULES",
+    "ProjectGraph",
     "RULES",
     "RetraceError",
     "SANITIZE_MODES",
     "Sanitizer",
     "SanitizerError",
     "TraceGuard",
+    "apply_fixes",
+    "apply_suppressions",
     "build_project_context",
     "lint_file",
     "lint_paths",
     "lint_source",
+    "summarize_module",
 ]

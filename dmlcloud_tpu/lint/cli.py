@@ -2,23 +2,42 @@
 
 Human output is one ``path:line:col: RULE message`` per finding (clickable
 in editors/CI logs); ``--format=json`` (or the ``--json`` shorthand) emits
-one stable machine-readable object::
+one stable machine-readable object (schema v2)::
 
     {
-      "version": 1,
+      "version": 2,
+      "status": "clean" | "findings" | "parse_error",
       "files_scanned": 12,
       "findings": [{"rule", "path", "line", "col", "message", "context"}...],
       "counts": {"DML101": 2}
     }
 
+``status`` distinguishes a DML999 parse failure (exit 2) from ordinary
+findings (exit 1) — in v1 both looked like findings, so a crashed parse
+was indistinguishable from a hazard in machine output. Every v1 key is
+still present with the same meaning (the v2 compatibility contract,
+tested in tests/test_lint_callgraph.py).
+
 ``--format=github`` emits GitHub Actions workflow commands
 (``::error file=...,line=...::``) so findings annotate the PR diff inline —
 ``scripts/lint_gate.sh`` wires this as the CI gate. ``--jobs N`` fans the
-scan over a process pool (findings stay in deterministic path order).
-``--select``/``--ignore`` take exact ids and ``DML2xx`` family wildcards.
+scan over a process pool (findings stay in deterministic path order; on a
+single-core host the pool collapses to serial). ``--select``/``--ignore``
+take exact ids and ``DML2xx``/``DML5xx`` family wildcards.
 
-Exit codes: 0 clean, 1 findings, 2 usage error. Pure stdlib — no jax
-import, safe to run anywhere (pre-commit hooks, CPU-only CI).
+Whole-program / workflow flags:
+
+- ``--no-callgraph`` skips the interprocedural DML5xx pass (module-local
+  rules only — the pre-PR-17 behavior).
+- ``--cache [PATH]`` enables the incremental cache (lint/cache.py);
+  unchanged files and everything they can't affect are reused.
+- ``--baseline PATH`` filters findings recorded in a baseline file;
+  ``--write-baseline PATH`` freezes the current findings into one.
+- ``--fix`` applies the mechanical autofixes (lint/fix.py) and re-lints;
+  ``--fix-suppress`` appends suppression directives to whatever remains.
+
+Exit codes: 0 clean, 1 findings, 2 parse/usage error. Pure stdlib — no
+jax import, safe to run anywhere (pre-commit hooks, CPU-only CI).
 """
 
 from __future__ import annotations
@@ -27,16 +46,18 @@ import argparse
 import json
 import sys
 
-from .engine import RULES, expand_rule_ids, iter_python_files, lint_paths
+from .cache import DEFAULT_CACHE_PATH
+from .engine import PARSE_ERROR_RULE, PROJECT_RULES, RULES, expand_rule_ids, iter_python_files, lint_paths
 
 
 def _parse_ids(spec: str) -> list[str]:
     ids = [p.strip() for p in spec.split(",") if p.strip()]
     expanded, unknown = expand_rule_ids(ids)
     if unknown:
+        known = ", ".join(sorted(set(RULES) | set(PROJECT_RULES)))
         raise argparse.ArgumentTypeError(
             f"unknown rule id(s)/family wildcard(s) {', '.join(unknown)}; "
-            f"known: {', '.join(sorted(RULES))} (families like DML2xx work too)"
+            f"known: {known} (families like DML2xx work too)"
         )
     return expanded
 
@@ -46,11 +67,38 @@ def _github_escape(msg: str) -> str:
     return msg.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
 
 
+def _baseline_keys(path: str) -> set[tuple] | None:
+    """(rule, path, line) triples recorded in a baseline file, or None if
+    it cannot be read/parsed (the caller reports and exits 2)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return {(e["rule"], e["path"], int(e["line"])) for e in data["findings"]}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _write_baseline(path: str, findings) -> bool:
+    payload = {
+        "version": 1,
+        "findings": [{"rule": f.rule, "path": f.path, "line": f.line} for f in findings],
+    }
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        print(f"lint: cannot write baseline {path}: {e}", file=sys.stderr)
+        return False
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dmlcloud_tpu lint",
         description="Flow-aware TPU-hazard linter enforcing the overlap engine's "
-        "sync-point contract and the sharding/concurrency contracts (doc/lint.md).",
+        "sync-point contract, the sharding/concurrency contracts, and the "
+        "interprocedural serving lifecycle contracts (doc/lint.md).",
     )
     parser.add_argument(
         "paths", nargs="*", default=["."],
@@ -58,7 +106,7 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--format", choices=("text", "json", "github"), default=None,
-        help="output format: text (default), json (stable schema v1), or "
+        help="output format: text (default), json (stable schema v2), or "
         "github (GitHub Actions ::error annotations)",
     )
     parser.add_argument(
@@ -66,16 +114,43 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--select", type=_parse_ids, default=None, metavar="IDS",
-        help="comma-separated rule ids or families (DML2xx) to run (default: all)",
+        help="comma-separated rule ids or families (DML2xx, DML5xx) to run (default: all)",
     )
     parser.add_argument(
         "--ignore", type=_parse_ids, default=None, metavar="IDS",
-        help="comma-separated rule ids or families (DML2xx) to skip",
+        help="comma-separated rule ids or families (DML2xx, DML5xx) to skip",
     )
     parser.add_argument(
         "--jobs", type=int, default=1, metavar="N",
-        help="lint files on N worker processes (default 1: serial, deterministic "
-        "output either way)",
+        help="lint files on N worker processes (default 1; auto-collapses to "
+        "serial on a single-core host — deterministic output either way)",
+    )
+    parser.add_argument(
+        "--no-callgraph", action="store_true",
+        help="skip the whole-program DML5xx pass (module-local rules only)",
+    )
+    parser.add_argument(
+        "--cache", nargs="?", const=DEFAULT_CACHE_PATH, default=None, metavar="PATH",
+        help=f"incremental cache file (default when given bare: {DEFAULT_CACHE_PATH}); "
+        "unchanged files and their unaffected importers are reused",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="suppress findings recorded in this baseline file (see --write-baseline)",
+    )
+    parser.add_argument(
+        "--write-baseline", default=None, metavar="PATH",
+        help="freeze the current findings into a baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--fix", action="store_true",
+        help="apply the mechanical autofixes (e.g. DML108 time.time -> "
+        "time.perf_counter) in place, then re-lint and report what remains",
+    )
+    parser.add_argument(
+        "--fix-suppress", action="store_true",
+        help="append '# dmllint: disable=...' directives to every remaining "
+        "finding line (use to bootstrap a gate over legacy code)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
@@ -95,15 +170,75 @@ def main(argv=None) -> int:
         return 2
 
     if args.list_rules:
-        for rid in sorted(RULES):
-            print(f"{rid}  {RULES[rid].title}")
+        for rid in sorted(set(RULES) | set(PROJECT_RULES)):
+            info = RULES.get(rid) or PROJECT_RULES[rid]
+            scope = " [project]" if rid in PROJECT_RULES else ""
+            print(f"{rid}  {info.title}{scope}")
         return 0
 
-    files_scanned = sum(1 for _ in iter_python_files(args.paths))
-    findings = lint_paths(args.paths, select=args.select, ignore=args.ignore, jobs=args.jobs)
+    baseline = None
+    if args.baseline is not None:
+        baseline = _baseline_keys(args.baseline)
+        if baseline is None:
+            print(f"lint: cannot read baseline {args.baseline}", file=sys.stderr)
+            return 2
 
+    def run():
+        return lint_paths(
+            args.paths,
+            select=args.select,
+            ignore=args.ignore,
+            jobs=args.jobs,
+            callgraph=not args.no_callgraph,
+            cache=args.cache,
+        )
+
+    files_scanned = sum(1 for _ in iter_python_files(args.paths))
+    findings = run()
+    if baseline is not None:
+        findings = [f for f in findings if (f.rule, f.path, f.line) not in baseline]
+
+    if args.write_baseline is not None:
+        if not _write_baseline(args.write_baseline, findings):
+            return 2
+        print(
+            f"lint: baseline {args.write_baseline} written "
+            f"({len(findings)} finding(s) frozen)",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.fix or args.fix_suppress:
+        from .fix import apply_fixes, apply_suppressions
+
+        if args.fix:
+            changed = apply_fixes(findings)
+            if changed:
+                print(
+                    f"lint: fixed {sum(changed.values())} finding(s) in "
+                    f"{len(changed)} file(s)",
+                    file=sys.stderr,
+                )
+                findings = run()
+                if baseline is not None:
+                    findings = [f for f in findings if (f.rule, f.path, f.line) not in baseline]
+        if args.fix_suppress:
+            remaining = [f for f in findings if f.rule != PARSE_ERROR_RULE]
+            annotated = apply_suppressions(remaining)
+            if annotated:
+                print(
+                    f"lint: suppressed {sum(annotated.values())} line(s) in "
+                    f"{len(annotated)} file(s)",
+                    file=sys.stderr,
+                )
+                findings = run()
+                if baseline is not None:
+                    findings = [f for f in findings if (f.rule, f.path, f.line) not in baseline]
+
+    parse_error = any(f.rule == PARSE_ERROR_RULE for f in findings)
+    status = "parse_error" if parse_error else ("findings" if findings else "clean")
     try:
-        _emit(fmt, findings, files_scanned)
+        _emit(fmt, findings, files_scanned, status)
     except BrokenPipeError:
         # `lint ... | head` closed the pipe: still exit with the real status
         # (stdout redirected to devnull so the interpreter's exit flush
@@ -111,10 +246,12 @@ def main(argv=None) -> int:
         import os
 
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    if parse_error:
+        return 2
     return 1 if findings else 0
 
 
-def _emit(fmt: str, findings, files_scanned: int) -> None:
+def _emit(fmt: str, findings, files_scanned: int, status: str) -> None:
     if fmt == "json":
         counts: dict[str, int] = {}
         for f in findings:
@@ -122,7 +259,8 @@ def _emit(fmt: str, findings, files_scanned: int) -> None:
         print(
             json.dumps(
                 {
-                    "version": 1,
+                    "version": 2,
+                    "status": status,
                     "files_scanned": files_scanned,
                     "findings": [f.to_dict() for f in findings],
                     "counts": {k: counts[k] for k in sorted(counts)},

@@ -47,7 +47,9 @@ __all__ = [
     "Finding",
     "LintError",
     "RULES",
+    "PROJECT_RULES",
     "rule",
+    "project_rule",
     "expand_rule_ids",
     "lint_source",
     "lint_file",
@@ -131,6 +133,24 @@ def rule(rule_id: str, title: str):
     return deco
 
 
+#: project-pass rule id -> RuleInfo; populated by ``@project_rule``
+#: (lifecycle.py). These run once per ``lint_paths`` call over the whole
+#: :class:`~dmlcloud_tpu.lint.callgraph.ProjectGraph`, never per file —
+#: ``lint_source``/``lint_file`` cannot see them by construction.
+PROJECT_RULES: dict[str, RuleInfo] = {}
+
+
+def project_rule(rule_id: str, title: str):
+    """Register a whole-program rule ``check(graph) -> Iterator[Finding]``
+    taking a :class:`~dmlcloud_tpu.lint.callgraph.ProjectGraph`."""
+
+    def deco(fn):
+        PROJECT_RULES[rule_id] = RuleInfo(rule_id, title, fn)
+        return fn
+
+    return deco
+
+
 def _id_matches(rule_id: str, spec: str) -> bool:
     """Whether ``spec`` selects ``rule_id``: exact id, ``all``, or a family
     wildcard like ``DML2xx`` (trailing ``xx`` matches any digits)."""
@@ -147,8 +167,9 @@ def expand_rule_ids(ids: Iterable[str]) -> tuple[list[str], list[str]]:
     and an unregistered exact id both land in ``unknown``."""
     expanded: list[str] = []
     unknown: list[str] = []
+    all_ids = sorted(set(RULES) | set(PROJECT_RULES))
     for spec in ids:
-        matched = [rid for rid in sorted(RULES) if _id_matches(rid, spec)]
+        matched = [rid for rid in all_ids if _id_matches(rid, spec)]
         if matched:
             expanded.extend(m for m in matched if m not in expanded)
         else:
@@ -660,6 +681,16 @@ def lint_source(
         ]
     ctx = ModuleCtx(path, source, tree, project=project)
     sup = Suppressions.parse(source)
+    return _run_module_rules(ctx, sup, select, ignore)
+
+
+def _run_module_rules(
+    ctx: ModuleCtx,
+    sup: Suppressions,
+    select: Iterable[str] | None,
+    ignore: Iterable[str] | None,
+) -> list[Finding]:
+    """Run the per-module RULES over one context, suppressions applied."""
     selected = set(expand_rule_ids(select)[0]) if select else set(RULES)
     ignored = set(expand_rule_ids(ignore)[0]) if ignore else set()
     out: set[Finding] = set()
@@ -721,15 +752,103 @@ def build_project_context(files: Iterable[str | os.PathLike]) -> "dataflow.Proje
     return project
 
 
-def _lint_file_task(args: tuple) -> list[Finding]:
-    """Top-level worker for the --jobs process pool (must be picklable).
-    Re-imports register the rules in the child; the project context arrives
-    as a plain axes set."""
-    path, select, ignore, axes = args
-    from . import rules, rules_concurrency, rules_data, rules_perf, rules_sharding  # noqa: F401 — register rules
+_EMPTY_SUP = {"by_line": {}, "file_wide": []}
 
-    project = dataflow.ProjectContext(declared_axes=set(axes))
-    return lint_file(path, select=select, ignore=ignore, project=project)
+
+def _sup_to_data(sup: Suppressions) -> dict:
+    """JSON form of a Suppressions (the incremental cache persists it so
+    the project pass can honor directives in files it never re-parses)."""
+    return {
+        "by_line": {str(k): sorted(v) for k, v in sup.by_line.items()},
+        "file_wide": sorted(sup.file_wide),
+    }
+
+
+def _sup_from_data(data: dict | None) -> Suppressions:
+    sup = Suppressions()
+    if data:
+        sup.by_line = {int(k): set(v) for k, v in data.get("by_line", {}).items()}
+        sup.file_wide = set(data.get("file_wide", ()))
+    return sup
+
+
+def _error_result(path: str, finding: Finding) -> dict:
+    return {"path": path, "findings": [finding], "summary": None, "axes": [], "sup": _EMPTY_SUP}
+
+
+def _module_result(
+    ctx: ModuleCtx,
+    select: Iterable[str] | None,
+    ignore: Iterable[str] | None,
+    want_summary: bool,
+) -> dict:
+    """Per-file analysis product: module-rule findings, the (optional)
+    call-graph summary, declared axes, and serialized suppressions — one
+    parse feeds all four (pass 1 and pass 2 share the ModuleCtx)."""
+    sup = Suppressions.parse(ctx.source)
+    findings = _run_module_rules(ctx, sup, select, ignore)
+    summary = None
+    if want_summary:
+        from .callgraph import summarize_module
+
+        summary = summarize_module(ctx)
+    return {
+        "path": ctx.path,
+        "findings": findings,
+        "summary": summary,
+        "axes": sorted(ctx.declared_axes),
+        "sup": _sup_to_data(sup),
+    }
+
+
+def _analyze_file(
+    path: str | os.PathLike,
+    select: Iterable[str] | None,
+    ignore: Iterable[str] | None,
+    project: "dataflow.ProjectContext",
+    want_summary: bool,
+) -> dict:
+    path = os.fspath(path)
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            source = f.read()
+    except OSError as e:
+        return _error_result(path, Finding(PARSE_ERROR_RULE, path, 1, 0, f"could not read file: {e}"))
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return _error_result(
+            path,
+            Finding(
+                PARSE_ERROR_RULE,
+                path,
+                int(e.lineno or 1),
+                int(e.offset or 0),
+                f"could not parse file: {e.msg}",
+            ),
+        )
+    ctx = ModuleCtx(path, source, tree, project=project)
+    return _module_result(ctx, select, ignore, want_summary)
+
+
+#: per-worker state installed once by the pool initializer — the pass-1
+#: axis registry and the run config are shared via fork/initargs instead
+#: of being rebuilt (or re-shipped) for every task
+_WORKER_STATE: dict = {}
+
+
+def _pool_init(select, ignore, axes, want_summary) -> None:
+    from . import lifecycle, rules, rules_concurrency, rules_data, rules_perf, rules_sharding  # noqa: F401 — register rules
+
+    _WORKER_STATE["select"] = select
+    _WORKER_STATE["ignore"] = ignore
+    _WORKER_STATE["project"] = dataflow.ProjectContext(declared_axes=set(axes))
+    _WORKER_STATE["want_summary"] = want_summary
+
+
+def _analyze_task(path: str) -> dict:
+    st = _WORKER_STATE
+    return _analyze_file(path, st["select"], st["ignore"], st["project"], st["want_summary"])
 
 
 def lint_paths(
@@ -738,27 +857,131 @@ def lint_paths(
     ignore: Iterable[str] | None = None,
     jobs: int = 1,
     project: "dataflow.ProjectContext | None" = None,
+    callgraph: bool = True,
+    cache: str | os.PathLike | None = None,
+    stats: dict | None = None,
 ) -> list[Finding]:
     """Lint files and/or directories (recursive); returns sorted findings.
 
-    Runs in two passes: pass 1 collects the project-wide mesh-axis registry
-    (so DML2xx rules see axes declared in *other* files), pass 2 runs the
-    rules. ``jobs > 1`` fans pass 2 out over a ``ProcessPoolExecutor``;
-    findings merge in path order either way, so output is deterministic."""
+    Two passes share one parse per file: pass 1 runs the per-module RULES
+    and extracts a call-graph summary; pass 2 folds every summary into a
+    :class:`~dmlcloud_tpu.lint.callgraph.ProjectGraph` and runs the
+    interprocedural PROJECT_RULES (DML5xx) over it — disable with
+    ``callgraph=False`` to fall back to the module-local rules only.
+
+    ``cache`` names an incremental cache file (lint/cache.py): unchanged
+    files reuse their cached findings/summaries; a changed file re-lints
+    itself plus its transitive reverse importers. ``stats`` (a dict, filled
+    in place) reports ``files``/``linted``/``reused`` for callers that need
+    to see the plan.
+
+    ``jobs > 1`` fans the per-file pass out over a ``ProcessPoolExecutor``
+    whose initializer installs the shared pass-1 registries once per
+    worker; on a single-core host the pool is a pure loss (measured in
+    BENCH_lint_pr05) so ``jobs`` silently collapses to 1 there. Findings
+    merge in path order either way, so output is deterministic."""
     files = list(iter_python_files(paths))
+    if jobs > 1 and (os.cpu_count() or 1) == 1:
+        jobs = 1
+
+    want_summary = callgraph or cache is not None
+    cache_obj = None
+    reused: dict[str, dict] = {}
+    to_lint: list[str] = list(files)
+    if cache is not None:
+        from .cache import LintCache
+
+        cache_obj = LintCache(cache, select=select, ignore=ignore)
+        to_lint, reused = cache_obj.plan(files)
+
     if project is None:
-        project = build_project_context(files)
-    findings: list[Finding] = []
-    if jobs > 1 and len(files) > 1:
+        project = dataflow.ProjectContext()
+    for entry in reused.values():
+        project.merge_module(set(entry.get("axes", ())))
+
+    results: list[dict] = []
+    if jobs > 1 and len(to_lint) > 1:
         from concurrent.futures import ProcessPoolExecutor
 
-        select_t = tuple(select) if select else None
-        ignore_t = tuple(ignore) if ignore else None
-        tasks = [(f, select_t, ignore_t, frozenset(project.declared_axes)) for f in files]
-        with ProcessPoolExecutor(max_workers=jobs) as pool:
-            for file_findings in pool.map(_lint_file_task, tasks):
-                findings.extend(file_findings)
+        # the axis registry must be complete before any worker lints, so
+        # the (cheap, axes-only) discovery pass stays in the parent
+        project.merge_module(build_project_context(to_lint).declared_axes)
+        initargs = (
+            tuple(select) if select else None,
+            tuple(ignore) if ignore else None,
+            frozenset(project.declared_axes),
+            want_summary,
+        )
+        with ProcessPoolExecutor(max_workers=jobs, initializer=_pool_init, initargs=initargs) as pool:
+            results.extend(pool.map(_analyze_task, to_lint))
     else:
-        for fpath in files:
-            findings.extend(lint_file(fpath, select=select, ignore=ignore, project=project))
-    return sorted(findings, key=Finding.sort_key)
+        # serial path parses once: contexts are built (and their axes
+        # merged) first, rules run after the registry is complete
+        pending: list[ModuleCtx] = []
+        for fpath in to_lint:
+            fpath = os.fspath(fpath)
+            try:
+                with open(fpath, "r", encoding="utf-8", errors="replace") as f:
+                    source = f.read()
+            except OSError as e:
+                results.append(
+                    _error_result(fpath, Finding(PARSE_ERROR_RULE, fpath, 1, 0, f"could not read file: {e}"))
+                )
+                continue
+            try:
+                tree = ast.parse(source)
+            except SyntaxError as e:
+                results.append(
+                    _error_result(
+                        fpath,
+                        Finding(
+                            PARSE_ERROR_RULE,
+                            fpath,
+                            int(e.lineno or 1),
+                            int(e.offset or 0),
+                            f"could not parse file: {e.msg}",
+                        ),
+                    )
+                )
+                continue
+            ctx = ModuleCtx(fpath, source, tree, project=project)
+            project.merge_module(ctx.declared_axes)
+            pending.append(ctx)
+        for ctx in pending:
+            results.append(_module_result(ctx, select, ignore, want_summary))
+
+    findings: list[Finding] = []
+    for entry in reused.values():
+        findings.extend(Finding(**d) for d in entry.get("findings", ()))
+    for r in results:
+        findings.extend(r["findings"])
+
+    if callgraph:
+        from . import lifecycle  # noqa: F401 — register the DML5xx rules
+        from .callgraph import ProjectGraph
+
+        summaries = [r["summary"] for r in results if r.get("summary")]
+        summaries += [e["summary"] for e in reused.values() if e.get("summary")]
+        graph = ProjectGraph(summaries)
+        sups = {r["path"]: _sup_from_data(r.get("sup")) for r in results}
+        for p, e in reused.items():
+            sups[p] = _sup_from_data(e.get("sup"))
+        selected = set(expand_rule_ids(select)[0]) if select else set(RULES) | set(PROJECT_RULES)
+        ignored = set(expand_rule_ids(ignore)[0]) if ignore else set()
+        for info in PROJECT_RULES.values():
+            if info.id not in selected or info.id in ignored:
+                continue
+            for f in info.check(graph):
+                sup = sups.get(f.path)
+                if sup is None or not sup.is_suppressed(f):
+                    findings.append(f)
+
+    if cache_obj is not None:
+        cache_obj.store(results, reused)
+
+    if stats is not None:
+        stats["files"] = len(files)
+        stats["linted"] = sorted(os.fspath(p) for p in to_lint)
+        stats["reused"] = sorted(reused)
+
+    return sorted(set(findings), key=Finding.sort_key)

@@ -207,7 +207,7 @@ class Scheduler:
         *,
         draft_pool: KVBlockPool | None = None,
         lookahead: int = 0,
-        prefix_cache=None,
+        prefix_cache: "PrefixCache | None" = None,
         max_waiting: int | None = None,
         shed_policy: str = "reject",
         fairness: str = "fifo",

@@ -1,11 +1,18 @@
-"""Bench receipt for the linter itself: lint_wall_s of a full self-lint
-run (dmlcloud_tpu/ + examples/ + bench.py + scripts/), serial vs --jobs.
+"""Bench receipt for the linter's incremental cache: cold vs warm wall
+seconds of a full self-lint (dmlcloud_tpu/ + examples/ + bench.py +
+scripts/, whole-program DML5xx pass included).
 
 The lint gate runs on every CI invocation and every pre-commit hook — its
-cost is part of the perf trajectory like any hot path, so it gets a
-receipt (BENCH_lint_pr05.json) the same way compile/overlap wins do.
+cost is part of the perf trajectory like any hot path. PR 5's receipt
+(BENCH_lint_pr05.json) recorded the serial-vs-jobs split; this one records
+the cache split: a warm run (nothing changed, everything replays from
+``.dmllint_cache.json``-style state) must finish in at most
+``WARM_BUDGET_FRAC`` of the cold run, and must produce byte-identical
+findings — a cache that changes the answer is a bug, not a perf number.
+``bench.py --gate --suite lint`` enforces both against the committed
+receipt (missing metric = FAIL).
 
-    python scripts/bench_lint.py [-o BENCH_lint_pr05.json] [--jobs N]
+    python scripts/bench_lint.py [-o BENCH_lint_pr17.json] [--repeats N]
 """
 
 from __future__ import annotations
@@ -13,7 +20,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import platform
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -24,44 +33,61 @@ from dmlcloud_tpu.lint.engine import iter_python_files  # noqa: E402
 
 TARGETS = ["dmlcloud_tpu", "examples", "bench.py", "scripts"]
 
+#: a warm (fully cached) run must cost at most this fraction of cold
+WARM_BUDGET_FRAC = 0.35
 
-def _time_lint(paths, jobs: int, repeats: int = 3) -> tuple[float, int]:
-    """Best-of-N wall seconds (best-of filters scheduler noise the same way
-    bench.py's step timers do) and the finding count of the last run."""
-    best = float("inf")
+
+def measure(repeats: int = 3) -> dict | None:
+    """Best-of-N cold and warm wall seconds over the self-lint targets.
+    Returns the receipt dict, or None if the warm run ever disagreed with
+    the cold run's findings (correctness before speed)."""
+    paths = [os.path.join(REPO, t) for t in TARGETS if os.path.exists(os.path.join(REPO, t))]
+    files = sum(1 for _ in iter_python_files(paths))
+    cold_best = warm_best = float("inf")
     findings = 0
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        result = lint_paths(paths, jobs=jobs)
-        best = min(best, time.perf_counter() - t0)
-        findings = len(result)
-    return best, findings
+    with tempfile.TemporaryDirectory() as tmp:
+        for i in range(repeats):
+            cache = os.path.join(tmp, f"cache{i}.json")
+            t0 = time.perf_counter()
+            cold = lint_paths(paths, cache=cache)
+            cold_best = min(cold_best, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            warm = lint_paths(paths, cache=cache)
+            warm_best = min(warm_best, time.perf_counter() - t0)
+            if warm != cold:
+                return None
+            findings = len(cold)
+    return {
+        "bench": "lint_incremental",
+        "value_source": "cpu_smoke",
+        "host": {
+            "cpu_count": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "targets": TARGETS,
+        "files_scanned": files,
+        "findings": findings,
+        "repeats_best_of": repeats,
+        "warm_budget_frac": WARM_BUDGET_FRAC,
+        "gate": {
+            "lint_cold_wall_s": round(cold_best, 4),
+            "lint_warm_wall_s": round(warm_best, 4),
+            "lint_incremental_ok": int(warm_best <= WARM_BUDGET_FRAC * cold_best),
+        },
+    }
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("-o", "--output", default=os.path.join(REPO, "BENCH_lint_pr05.json"))
-    parser.add_argument("--jobs", type=int, default=max(2, min(os.cpu_count() or 2, 8)))
+    parser.add_argument("-o", "--output", default=os.path.join(REPO, "BENCH_lint_pr17.json"))
     parser.add_argument("--repeats", type=int, default=3)
     args = parser.parse_args(argv)
 
-    paths = [os.path.join(REPO, t) for t in TARGETS]
-    files = sum(1 for _ in iter_python_files(paths))
-    serial_s, findings = _time_lint(paths, jobs=1, repeats=args.repeats)
-    jobs_s, _ = _time_lint(paths, jobs=args.jobs, repeats=args.repeats)
-
-    receipt = {
-        "bench": "lint_selflint",
-        "targets": TARGETS,
-        "files_scanned": files,
-        "findings": findings,
-        "repeats_best_of": args.repeats,
-        "lint_wall_s": round(serial_s, 4),
-        "lint_wall_s_jobs": round(jobs_s, 4),
-        "jobs": args.jobs,
-        "speedup": round(serial_s / jobs_s, 3) if jobs_s > 0 else None,
-        "rules": "DML1xx + DML2xx + DML3xx (flow-aware engine, project axis registry)",
-    }
+    receipt = measure(repeats=args.repeats)
+    if receipt is None:
+        print("bench_lint: FAIL — warm run disagreed with cold run", file=sys.stderr)
+        return 1
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(receipt, f, indent=2, sort_keys=True)
         f.write("\n")

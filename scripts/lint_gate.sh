@@ -4,9 +4,22 @@
 # --format=github makes each finding an inline PR annotation on GitHub
 # Actions; locally the same command prints ::error lines and exits 1.
 #
-# Usage: scripts/lint_gate.sh [extra lint args, e.g. --jobs 4]
+# Usage: scripts/lint_gate.sh [--changed] [extra lint args, e.g. --jobs 4]
+#   --changed   incremental mode: enables the lint cache (.dmllint_cache.json)
+#               so only files that changed since the last run — plus their
+#               transitive reverse importers — are re-analyzed. Findings are
+#               identical to a cold run (the cache is advisory); use it for
+#               pre-commit hooks and local iteration, keep CI cold.
 # CI runs this first, then the perf regression gate:
 #     scripts/lint_gate.sh && scripts/perf_gate.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m dmlcloud_tpu lint dmlcloud_tpu examples bench.py scripts --format=github "$@"
+args=()
+for a in "$@"; do
+  if [ "$a" = "--changed" ]; then
+    args+=("--cache")
+  else
+    args+=("$a")
+  fi
+done
+exec python -m dmlcloud_tpu lint dmlcloud_tpu examples bench.py scripts --format=github "${args[@]+"${args[@]}"}"

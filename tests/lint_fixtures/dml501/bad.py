@@ -1,0 +1,21 @@
+"""Two DML501 leaks: a conditional release and a no-op helper handoff."""
+
+from .helpers import inspect_only
+from .pools import KVBlockPool, PrefixCache
+
+
+def admit_leaky(pool: KVBlockPool, n, ready):
+    blocks = pool.alloc(n)
+    if ready:
+        pool.release(blocks)
+        return True
+    return False
+
+
+def lock_and_forget(cache: PrefixCache, tokens, want):
+    blocks, matched = cache.lock(tokens)
+    if want:
+        cache.unlock(blocks)
+        return matched
+    inspect_only(blocks)
+    return 0

@@ -1,0 +1,37 @@
+"""Every custody shape DML501 must stay silent on."""
+
+from .helpers import adopt, give_back
+from .pools import KVBlockPool, PrefixCache
+
+
+def admit_balanced(pool: KVBlockPool, n, ready):
+    blocks = pool.alloc(n)
+    if ready:
+        pool.release(blocks)
+        return True
+    pool.release(blocks)
+    return False
+
+
+def handoff_to_releasing_helper(pool: KVBlockPool, n):
+    blocks = pool.alloc(n)
+    give_back(pool, blocks)
+    return n
+
+
+def handoff_to_new_owner(pool: KVBlockPool, owner, n):
+    blocks = pool.alloc(n)
+    adopt(owner, blocks)
+    return n
+
+
+def escape_by_return(pool: KVBlockPool, n):
+    blocks = pool.alloc(n)
+    return blocks
+
+
+def truthiness_guarded(cache: PrefixCache, tokens):
+    blocks, matched = cache.lock(tokens)
+    if blocks:
+        cache.unlock(blocks)
+    return matched

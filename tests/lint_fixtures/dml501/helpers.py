@@ -1,0 +1,18 @@
+"""Helper custody shapes the call-graph pass must distinguish."""
+
+from .pools import KVBlockPool
+
+
+def give_back(pool: KVBlockPool, blocks):
+    pool.release(blocks)
+
+
+def adopt(owner, blocks):
+    owner.blocks = blocks
+
+
+def inspect_only(blocks):
+    count = 0
+    for _block in blocks:
+        count += 1
+    return count

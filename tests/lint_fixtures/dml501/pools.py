@@ -1,0 +1,30 @@
+"""Minimal refcounted-pool doubles for the DML501 ownership fixtures."""
+
+
+class KVBlockPool:
+    def __init__(self, capacity):
+        self.free_list = list(range(capacity))
+
+    def alloc(self, n):
+        out, self.free_list = self.free_list[:n], self.free_list[n:]
+        return out
+
+    def retain(self, blocks):
+        return blocks
+
+    def release(self, blocks):
+        self.free_list.extend(blocks)
+
+    def freeze(self):
+        return tuple(self.free_list)
+
+
+class PrefixCache:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def lock(self, tokens):
+        return self.pool.alloc(1), len(tokens)
+
+    def unlock(self, blocks):
+        self.pool.release(blocks)

@@ -1,0 +1,4 @@
+"""Re-export shim: the rename pattern that blinded DML211's vocabulary."""
+
+from .ops import scatter_tokens as table_write  # noqa: F401
+from .store import KVBlockPool as BlockStore  # noqa: F401

@@ -1,0 +1,15 @@
+from .store import KVBlockPool
+from .writer import write_unguarded
+
+
+def outer(pool: KVBlockPool, tables, tokens):
+    write_unguarded(pool, tables, tokens)
+
+
+def outer_guarded(pool: KVBlockPool, tables, tokens):
+    ensure_writable(tables)
+    write_unguarded(pool, tables, tokens)
+
+
+def ensure_writable(tables):
+    del tables

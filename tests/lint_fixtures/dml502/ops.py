@@ -1,0 +1,7 @@
+"""Paged write kernel double — deliberately vocabulary-free."""
+
+
+def scatter_tokens(tables, tokens):
+    for i, tok in enumerate(tokens):
+        tables[i] = tok
+    return tables

@@ -1,0 +1,18 @@
+"""No pool/scatter vocabulary anywhere in this file — only the graph can
+see that ``table_write`` IS the paged scatter (satellite regression for
+the DML211/DML212 rename false-negative)."""
+
+from ._alias import BlockStore, table_write
+
+
+def sneaky(tables, tokens):
+    table_write(tables, tokens)
+
+
+def sneaky_guarded(store: BlockStore, tables, tokens):
+    make_writable(tables)
+    table_write(tables, tokens)
+
+
+def make_writable(tables):
+    del tables

@@ -1,0 +1,12 @@
+"""Pool double that puts importers in DML502 scope by resolution."""
+
+
+class KVBlockPool:
+    def __init__(self, capacity):
+        self.capacity = capacity
+
+    def alloc(self, n):
+        return list(range(n))
+
+    def release(self, blocks):
+        del blocks

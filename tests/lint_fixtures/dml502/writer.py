@@ -1,0 +1,15 @@
+from .ops import scatter_tokens
+from .store import KVBlockPool
+
+
+def write_unguarded(pool: KVBlockPool, tables, tokens):
+    scatter_tokens(tables, tokens)
+
+
+def write_guarded(pool: KVBlockPool, tables, tokens):
+    fork_if_shared(pool, tables)
+    scatter_tokens(tables, tokens)
+
+
+def fork_if_shared(pool, tables):
+    del pool, tables

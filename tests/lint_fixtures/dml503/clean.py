@@ -1,0 +1,18 @@
+"""Single-exit shapes that hold the contract."""
+
+
+def terminate_once(seq):
+    if seq.status is not None:
+        return False
+    seq.status = "ok"
+    return True
+
+
+def finish(seq):
+    return terminate_once(seq)
+
+
+def finalize_batch(seqs):
+    for seq in seqs:
+        seq.status = "error"
+    return len(seqs)

@@ -1,0 +1,16 @@
+"""Single-exit contract violations: a stampless exit and a double stamp."""
+
+TERMINAL_STATUSES = ("ok", "cancelled", "deadline_exceeded", "shed", "error")
+
+
+def terminate_missing(seq, success):
+    if success:
+        seq.status = "ok"
+        return True
+    return False
+
+
+def terminate_double(seq):
+    seq.status = "error"
+    seq.status = "cancelled"
+    return True

@@ -1,0 +1,6 @@
+"""Base class whose inherited method joins the lock protocol."""
+
+
+class DrainBase:
+    def drain_one(self):
+        self.pending -= 1

@@ -1,0 +1,40 @@
+import threading
+
+from .shared import bump_pending
+
+
+def bump_locked(pipeline, n):
+    with pipeline._lock:
+        pipeline.pending += n
+
+
+class LockedFlusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self.running = True
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while self.running:
+            bump_locked(self, 1)
+
+    def snapshot(self):
+        with self._lock:
+            out = self.pending
+            self.pending = 0
+        return out
+
+
+class UnsharedWorker:
+    def __init__(self):
+        self.count = 0
+        self.running = True
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while self.running:
+            bump_pending(self, 1)
+
+    def reset(self):
+        self.count = 0

@@ -1,0 +1,5 @@
+"""Module-level helper mutating state on behalf of a thread target."""
+
+
+def bump_pending(pipeline, n):
+    pipeline.pending += n

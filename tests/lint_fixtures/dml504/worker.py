@@ -1,0 +1,38 @@
+import threading
+
+from .base import DrainBase
+from .shared import bump_pending
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self.running = True
+        self._thread = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        while self.running:
+            bump_pending(self, 1)
+
+    def snapshot(self):
+        with self._lock:
+            out = self.pending
+            self.pending = 0
+        return out
+
+
+class Drainer(DrainBase):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self.running = True
+        self._thread = threading.Thread(target=self._spin)
+
+    def _spin(self):
+        while self.running:
+            self.drain_one()
+
+    def enqueue(self, n):
+        with self._lock:
+            self.pending += n
