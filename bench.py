@@ -1944,6 +1944,10 @@ _DATA_CFG = dict(
     vocab=512, layers=2, heads=4, kv=2, head_dim=32, hidden=128, mlp=256,
     seq_len=256, batch=8, n_docs=768, len_median=64.0, len_sigma=0.6,
     min_len=4, chunk_docs=192, mix_weights=(3.0, 1.0), seed=0, epochs=2,
+    # the disk arm (PR 18): the same mixed stream staged as mmap'd
+    # .dmlshard files and re-read through the async ShardReader, packed
+    # with the window FFD packer instead of the chunked greedy fill
+    pack_window=512, shard_tokens=16384, reader_buffers=2, read_ahead=64,
 )
 
 
@@ -1974,7 +1978,7 @@ def _data_mix_stream():
     )
 
 
-def _data_arm(packed: bool, stats=None) -> dict:
+def _data_arm(packed: bool, stats=None, disk_dir=None) -> dict:
     """One arm of the A/B through the real TrainValStage train step: the
     mixed document stream either pad-to-max (one document per row,
     ``segment_ids`` marking the pad slots — the correct-loss baseline) or
@@ -1982,7 +1986,14 @@ def _data_arm(packed: bool, stats=None) -> dict:
     decoder with the segment-masked loss; telemetry arms the goodput
     ledger, so data_wait and pad_fraction come from the same accounting
     production runs use. Epoch 1 absorbs any warmup; the reported numbers
-    come from epoch 2's tracker metrics."""
+    come from epoch 2's tracker metrics.
+
+    ``disk_dir`` switches the source to the disk plane: the async
+    ``ShardReader`` over the staged ``.dmlshard`` corpus (same document
+    order as the in-memory mix), packed by the window-FFD packer
+    (``pack_window=``) instead of the chunked greedy fill — epoch 1
+    additionally absorbs the cold mmap page faults, so epoch 2 is the
+    sustained-from-disk figure."""
     import optax
 
     import dmlcloud_tpu as dml
@@ -2001,11 +2012,18 @@ def _data_arm(packed: bool, stats=None) -> dict:
     def collate(rows):
         return {k: np.stack([r[k] for r in rows]) for k in ("tokens", "segment_ids")}
 
-    stream = _data_mix_stream()
-    if packed:
-        stream = stream.pack_stream(seq_len, chunk_docs=c["chunk_docs"], stats=stats)
+    if disk_dir is not None:
+        from dmlcloud_tpu.data import ShardReader
+
+        stream = ShardReader(
+            disk_dir, buffers=c["reader_buffers"], read_ahead=c["read_ahead"]
+        ).pack_stream(seq_len, pack_window=c["pack_window"], stats=stats)
     else:
-        stream = stream.map(pad_row)
+        stream = _data_mix_stream()
+        if packed:
+            stream = stream.pack_stream(seq_len, chunk_docs=c["chunk_docs"], stats=stats)
+        else:
+            stream = stream.map(pad_row)
     ds = stream.batch(batch, drop_remainder=True, collate=collate)
 
     class DataStage(dml.TrainValStage):
@@ -2040,7 +2058,8 @@ def _data_arm(packed: bool, stats=None) -> dict:
         def log_every(self):
             return 0
 
-    pipeline = dml.TrainingPipeline(name=f"bench-data-{'packed' if packed else 'pad'}", telemetry=True)
+    arm_name = "disk" if disk_dir is not None else ("packed" if packed else "pad")
+    pipeline = dml.TrainingPipeline(name=f"bench-data-{arm_name}", telemetry=True)
     pipeline.append_stage(DataStage(), max_epochs=c["epochs"], name="stage")
     pipeline.run()
     tracker = pipeline.tracker
@@ -2069,13 +2088,58 @@ def _data_arm(packed: bool, stats=None) -> dict:
     }
 
 
+def _data_disk_replay_drill(corpus_dir: str) -> float:
+    """The 4→2 reshard zero-replay drill, pure host: four ws=4 readers
+    consume a prefix in lockstep, one saves its cursor, two ws=2 readers
+    resume from it and drain. Every record is keyed by content (random
+    int32 docs — collisions are astronomically unlikely) and must be seen
+    EXACTLY once across the two phases: a replayed record double-counts,
+    a skipped record never appears. Returns 1.0 on exact coverage."""
+    from dmlcloud_tpu.data import ShardReader, ShardStore
+
+    store = ShardStore(corpus_dir)
+    n = store.total_records
+    expected = {}
+    for g in range(n):
+        expected.setdefault(store.record(g).tobytes(), []).append(g)
+    seen: dict = {}
+
+    def consume(rec):
+        key = rec.tobytes()
+        seen[key] = seen.get(key, 0) + 1
+
+    k = max(1, (n // 4) // 3)  # a third of the corpus before the reshard
+    readers4 = [ShardReader(store, rank=r, world_size=4) for r in range(4)]
+    iters = [iter(r) for r in readers4]
+    for _ in range(k):
+        for it in iters:
+            consume(next(it))
+    state = readers4[0].state_dict()
+    if state["global_offset"] != 4 * k:
+        return 0.0
+    for r in range(2):
+        reader = ShardReader(store, rank=r, world_size=2)
+        reader.load_state_dict(state)
+        for rec in reader:
+            consume(rec)
+    ok = all(seen.get(key, 0) == len(gs) for key, gs in expected.items()) and sum(
+        seen.values()
+    ) == n
+    return float(ok)
+
+
 def data_child_main():
     """A/B the streaming packed data plane against pad-to-max on the pinned
-    ragged corpus (CPU-pinned child); prints one marker line of JSON — the
-    source of ``BENCH_data_*.json`` and of ``bench.py --gate --suite
-    data``'s current numbers."""
+    ragged corpus, plus the disk arm — the same mixed stream staged as
+    mmap'd ``.dmlshard`` files, read back through the async ``ShardReader``
+    and packed by the window-FFD packer (CPU-pinned child); prints one
+    marker line of JSON — the source of ``BENCH_data_*.json`` and of
+    ``bench.py --gate --suite data``'s current numbers."""
     jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
     from dmlcloud_tpu.data import PackStats
+    from dmlcloud_tpu.data.store import build_corpus
     from dmlcloud_tpu.native import pack as native_pack
 
     c = _DATA_CFG
@@ -2086,6 +2150,23 @@ def data_child_main():
     packed = _data_arm(packed=True, stats=stats)
     packed["pack"] = stats.as_dict()
 
+    # stage the SAME mixed document stream to disk and re-run the packed
+    # arm through the shard plane (epoch 1 absorbs the cold mmap faults;
+    # epoch 2 is the sustained-from-disk figure)
+    with tempfile.TemporaryDirectory(prefix="bench-data-shards-") as corpus_dir:
+        manifest = build_corpus(
+            corpus_dir, _data_mix_stream(), shard_tokens=c["shard_tokens"]
+        )
+        disk_stats = PackStats()
+        disk = _data_arm(packed=True, stats=disk_stats, disk_dir=corpus_dir)
+        disk["pack"] = disk_stats.as_dict()
+        disk["corpus"] = {
+            "shards": len(manifest["shards"]),
+            "records": manifest["total_records"],
+            "tokens": manifest["total_tokens"],
+        }
+        zero_replay = _data_disk_replay_drill(corpus_dir)
+
     speedup = (
         round(packed["tokens_per_sec"] / pad["tokens_per_sec"], 3)
         if packed["tokens_per_sec"] and pad["tokens_per_sec"]
@@ -2093,7 +2174,9 @@ def data_child_main():
     )
     reclaimed = round(pad["pad_fraction"] - packed["pad_fraction"], 4)
     zero_recompiles = float(
-        (pad["recompiles"] or 0) == 0 and (packed["recompiles"] or 0) == 0
+        (pad["recompiles"] or 0) == 0
+        and (packed["recompiles"] or 0) == 0
+        and (disk["recompiles"] or 0) == 0
     )
     results = {
         "workload": {
@@ -2105,9 +2188,11 @@ def data_child_main():
         "host": _host_fingerprint(),
         "pad_to_max": pad,
         "packed_stream": packed,
+        "disk_stream": disk,
         "packed_vs_pad_tokens_per_sec": speedup,
         # wasted-token fraction before vs after: the reclaimed padding
         "padding_waste_reclaimed": reclaimed,
+        "disk_zero_replay": zero_replay,
         # the flat, schema-stable section the perf gate compares
         "gate": {
             "data_packed_speedup_vs_pad": speedup,
@@ -2115,6 +2200,13 @@ def data_child_main():
             "data_padding_waste_reclaimed": reclaimed,
             "data_zero_recompiles": zero_recompiles,
             "data_wait_s": packed["data_wait_s"],
+            # the disk plane (PR 18): sustained tokens/s from the mmap'd
+            # corpus, the FFD pad fraction (lower-is-better), the reader's
+            # data_wait (lower-is-better), and the 4->2 reshard drill bit
+            "data_disk_tokens_per_sec": disk["tokens_per_sec"],
+            "data_disk_pad_fraction": disk["pad_fraction"],
+            "data_disk_wait_s": disk["data_wait_s"],
+            "data_disk_zero_replay": zero_replay,
         },
     }
     print(_DATA_MARKER + json.dumps(results), flush=True)
@@ -2311,6 +2403,8 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "serve_router_failover_p99_ttft_s",
         "serve_router_hot_tenant_cold_p99_ttft_s",
         "data_wait_s",
+        "data_disk_wait_s",
+        "data_disk_pad_fraction",
         "tier1_suite_wall_s",
         "lint_cold_wall_s",
         "lint_warm_wall_s",
@@ -2484,10 +2578,12 @@ def gate_main(argv: list) -> int:
     robustness keys and the ``serve_router_*`` failover/drain keys —
     latencies judged lower-is-better; every receipt's keys stay enforced,
     so a silently-vanished metric FAILS); the ``data`` suite replays the streaming
-    packed-vs-pad-to-max A/B against the last committed
-    ``BENCH_data_*.json`` (packed tokens/s speedup, padding waste
-    reclaimed, 0 mid-run recompiles, data_wait as a lower-is-better
-    latency); the ``tier1`` suite (opt-in, not part of ``all``) times the
+    packed-vs-pad-to-max A/B plus the disk arm against EVERY committed
+    ``BENCH_data_*.json`` merged into one baseline (packed tokens/s
+    speedup, padding waste reclaimed, 0 mid-run recompiles, data_wait as
+    a lower-is-better latency, and the PR-18 disk keys: sustained
+    tokens/s off the mmap'd shards, the FFD pad fraction and reader wait
+    lower-is-better, the 4→2 reshard zero-replay bit); the ``tier1`` suite (opt-in, not part of ``all``) times the
     tier-1 pytest run and gates its wall seconds lower-is-better against
     the last ``BENCH_tier1_*.json``; the ``lint`` suite (also opt-in) runs
     the incremental-cache cold/warm A/B (scripts/bench_lint.py) and gates
@@ -2611,7 +2707,12 @@ def gate_main(argv: list) -> int:
         rcs.append(run_gate(baseline, current, tolerance))
     if suite in ("data", "all"):
         baseline = _opt("--baseline") if suite == "data" else None
-        baseline = baseline or _latest_receipt("data")
+        if baseline is None:
+            # EVERY committed data receipt folds into ONE merged baseline
+            # (PR 18): pr09's in-memory keys and pr18's disk keys are
+            # enforced together — a vanished metric FAILS, the latest
+            # committed value is each key's floor
+            baseline = _merged_baseline(["BENCH_data_*.json"])
         if baseline is None:
             print("gate: FAIL — no --baseline and no committed BENCH_data_*.json", file=sys.stderr)
             return 2

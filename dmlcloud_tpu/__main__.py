@@ -15,7 +15,7 @@
 
     python -m dmlcloud_tpu                  # diagnostics (diag is implied)
     python -m dmlcloud_tpu --json           # machine-readable diagnostics
-    python -m dmlcloud_tpu diag [--json] [--run RUN_DIR]
+    python -m dmlcloud_tpu diag [--json] [--run RUN_DIR] [--corpus DIR]
     python -m dmlcloud_tpu lint [paths...] [--json] [--list-rules]
     python -m dmlcloud_tpu timeline RUN_DIR [-o trace.json]
 
@@ -142,6 +142,22 @@ def _native_info() -> dict:
     return info
 
 
+def _corpus_info(directory: str) -> dict:
+    """Shard-store summary for ``diag --corpus`` — opens and CHECKSUMS every
+    shard, so a truncated or bit-flipped file surfaces here (named) instead
+    of mid-run. Returns ``{"error": ...}`` rather than raising: diag is a
+    diagnostic, the broken corpus IS the finding."""
+    from .data.store import ShardCorruptError, ShardStore
+
+    try:
+        store = ShardStore(directory, verify=True)
+    except ShardCorruptError as e:
+        return {"directory": directory, "error": str(e), "file": e.path}
+    except (FileNotFoundError, OSError) as e:
+        return {"directory": directory, "error": str(e)}
+    return store.info()
+
+
 def _diag_main(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dmlcloud_tpu diag",
@@ -153,6 +169,11 @@ def _diag_main(argv) -> int:
         help="also summarize a telemetry-armed run directory (goodput ledger "
         "totals + journal span counts)",
     )
+    parser.add_argument(
+        "--corpus", default=None, metavar="DIR",
+        help="also inspect a .dmlshard corpus directory (format version, "
+        "shard/record counts; checksums every shard and names a corrupt file)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -163,6 +184,7 @@ def _diag_main(argv) -> int:
 
     cache = cache_stats()
     native = _native_info()
+    corpus = _corpus_info(args.corpus) if args.corpus else None
     telemetry = _run_telemetry_summary(args.run) if args.run else None
     if not args.json:
         print(f"dmlcloud_tpu {__version__}")
@@ -180,6 +202,17 @@ def _diag_main(argv) -> int:
         )
         if native.get("hint"):
             print(f"    - hint: {native['hint']}")
+        if corpus is not None:
+            print(f"* SHARD STORE ({corpus['directory']}):")
+            if "error" in corpus:
+                print(f"    - error: {corpus['error']}")
+            else:
+                print(f"    - format version: {corpus['format_version']}")
+                print(f"    - shards: {corpus['shards']}")
+                print(
+                    f"    - records: {corpus['total_records']} "
+                    f"({corpus['total_tokens']} tokens), checksums OK"
+                )
         if telemetry is not None:
             print(f"* TELEMETRY ({telemetry['run_dir']}):")
             gp = telemetry.get("goodput")
@@ -204,6 +237,8 @@ def _diag_main(argv) -> int:
     info = {"version": __version__, "python": sys.version.split()[0], "jax": jax.__version__}
     info["compile_cache"] = cache
     info["native"] = native
+    if corpus is not None:
+        info["shard_store"] = corpus
     if telemetry is not None:
         info["telemetry"] = telemetry
     info.update(accelerator_info())  # {"error": ...} when backend init fails

@@ -14,6 +14,15 @@ from .datasets import (
 )
 from .device import device_iterator
 from .sharding import chunk_and_shard_indices, shard_indices, shard_sequence
+from .store import (
+    CorpusBuilder,
+    ShardCorruptError,
+    ShardFile,
+    ShardReader,
+    ShardStore,
+    build_corpus,
+    write_shard,
+)
 from .synthetic import markov_tokens
 
 __all__ = [
@@ -34,4 +43,11 @@ __all__ = [
     "chunk_and_shard_indices",
     "shard_indices",
     "shard_sequence",
+    "CorpusBuilder",
+    "ShardCorruptError",
+    "ShardFile",
+    "ShardReader",
+    "ShardStore",
+    "build_corpus",
+    "write_shard",
 ]

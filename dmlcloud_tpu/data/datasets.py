@@ -259,6 +259,7 @@ class DataPipeline(_DatasetBase):
         chunk_docs: int = 1024,
         *,
         split_long: bool = True,
+        pack_window: int = 0,
         stats: "PackStats | None" = None,
     ) -> "DataPipeline":
         """Streaming chunked packing: buffer up to ``chunk_docs`` documents,
@@ -280,14 +281,31 @@ class DataPipeline(_DatasetBase):
         pipeline's ``pack_stats`` (a :class:`PackStats`, live-updated
         during iteration) accounts for it: total padding-waste fraction
         and the chunk-boundary share, the numbers the ``BENCH_data_*``
-        receipts report (doc/data.md)."""
+        receipts report (doc/data.md).
+
+        ``pack_window > 0`` switches to **window-based first-fit-decreasing
+        packing** (:func:`_pack_ffd_iter`): documents are buffered in
+        windows of ``pack_window``, sorted longest-first (stable — arrival
+        order breaks ties), and first-fit placed into open rows that
+        persist ACROSS windows, so there is no chunk-boundary tail waste
+        at all — the only padding left is the end-of-stream flush and the
+        slivers no remaining document fits. This reclaims most of the
+        ~19% greedy pad_fraction (BENCH_data_pr18 measures ≤ 0.10 on the
+        pinned corpus) at the cost of reordering rows WITHIN a window
+        horizon; the emitted row sequence is still bit-deterministic given
+        the input stream and ``pack_window`` (doc/data.md, "FFD window
+        semantics"). ``chunk_docs`` is ignored in this mode."""
         if seq_len < 1:
             raise ValueError(f"seq_len must be >= 1, got {seq_len}")
         if chunk_docs < 1:
             raise ValueError(f"chunk_docs must be >= 1, got {chunk_docs}")
+        if pack_window < 0:
+            raise ValueError(f"pack_window must be >= 0, got {pack_window}")
         st = stats if stats is not None else PackStats()
 
         def wrap(it: Iterator, _e) -> Iterator:
+            if pack_window:
+                return _pack_ffd_iter(it, seq_len, pack_window, split_long, st)
             return _pack_stream_iter(it, seq_len, chunk_docs, split_long, st)
 
         out = self._chain(wrap)
@@ -496,6 +514,104 @@ def _pack_stream_iter(docs: Iterator, seq_len: int, chunk_docs: int, split_long:
             buf = []
     if buf:
         yield from pack_chunk(buf)
+
+
+def _pack_ffd_iter(docs: Iterator, seq_len: int, window_docs: int, split_long: bool, stats: PackStats) -> Iterator[dict]:
+    """Window-based first-fit-decreasing packing (``pack_stream(...,
+    pack_window=N)``).
+
+    Documents buffer in windows of ``window_docs``; each window is sorted
+    longest-first (stable — equal lengths keep arrival order) and first-fit
+    placed into open rows ("bins"). Unlike the chunked greedy packer, bins
+    are NOT flushed at window boundaries: a partially-filled row stays open
+    for the next window's documents, so the chunk-boundary tail waste
+    disappears entirely — the only padding left is (a) slivers no remaining
+    document fits and (b) the end-of-stream flush, which is the only place
+    this packer adds to ``boundary_pad_slots``.
+
+    Rows are emitted the moment they fill (or when the open-bin cap — ``max
+    (window_docs, 16)`` — evicts the fullest, oldest-first bin to bound
+    memory), so downstream stages stream. Everything is pure sequential
+    bookkeeping over the input order: the emitted row sequence is
+    bit-deterministic given (input stream, ``seq_len``, ``window_docs``).
+    """
+    max_open = max(int(window_docs), 16)
+    bins: list[list] = []  # [fill, parts]; list order == creation order == first-fit order
+
+    def emit(parts: list, fill: int, boundary: bool = False) -> dict:
+        tokens = np.zeros(seq_len, np.int32)
+        segs = np.zeros(seq_len, np.int32)
+        at = 0
+        for seg, p in enumerate(parts, 1):
+            tokens[at : at + p.size] = p
+            segs[at : at + p.size] = seg
+            at += p.size
+        stats.rows += 1
+        stats.slots += seq_len
+        stats.pad_slots += seq_len - fill
+        stats.tokens_placed += fill
+        if boundary:
+            stats.boundary_pad_slots += seq_len - fill
+        return {"tokens": tokens, "segment_ids": segs}
+
+    def place(part: np.ndarray) -> dict | None:
+        for b in bins:
+            if b[0] + part.size <= seq_len:
+                b[1].append(part)
+                b[0] += part.size
+                if b[0] == seq_len:
+                    bins.remove(b)
+                    return emit(b[1], b[0])
+                return None
+        bins.append([int(part.size), [part]])
+        if len(bins) > max_open:
+            # bound memory: close the fullest bin (ties -> oldest); its
+            # padding is ordinary waste, not boundary waste
+            full = max(bins, key=lambda b: b[0])
+            bins.remove(full)
+            return emit(full[1], full[0])
+        return None
+
+    def run_window(buf: list) -> Iterator[dict]:
+        arrays = [np.asarray(d, np.int32).ravel() for d in buf]
+        stats.docs += len(arrays)
+        arrays = [a for a in arrays if a.size]
+        if not arrays:
+            return
+        stats.tokens_in += sum(int(a.size) for a in arrays)
+        stats.chunks += 1
+        parts: list[np.ndarray] = []
+        for a in arrays:
+            if a.size > seq_len:
+                if split_long:
+                    # whole seq_len pieces are born full rows; the tail
+                    # joins the window's FFD pool like any short document
+                    off = 0
+                    while a.size - off >= seq_len:
+                        yield emit([a[off : off + seq_len]], seq_len)
+                        off += seq_len
+                    if off < a.size:
+                        parts.append(a[off:])
+                else:
+                    yield emit([a[:seq_len]], seq_len)
+            else:
+                parts.append(a)
+        parts.sort(key=lambda p: p.size, reverse=True)  # stable: ties keep arrival order
+        for p in parts:
+            row = place(p)
+            if row is not None:
+                yield row
+
+    buf: list = []
+    for doc in docs:
+        buf.append(doc)
+        if len(buf) == window_docs:
+            yield from run_window(buf)
+            buf = []
+    if buf:
+        yield from run_window(buf)
+    for fill, parts in bins:  # end-of-stream flush: the only boundary waste
+        yield emit(parts, fill, boundary=True)
 
 
 # ---------------------------------------------------------------------------
@@ -709,11 +825,13 @@ def _iter_chunks(
         yield chunk
 
 
-def _prefetch_iter(src: Iterator, num_elements: int) -> Iterator:
+def _prefetch_iter(src: Iterator, num_elements: int, name: str = "dml-host-prefetch") -> Iterator:
     """Bounded-queue background reader. Exceptions in the source re-raise in
     the consumer; closing/abandoning the consumer generator signals the
     producer to stop (otherwise it would block forever on a full queue,
-    pinning the thread, its queued batches, and the source iterator)."""
+    pinning the thread, its queued batches, and the source iterator).
+    ``name`` labels the producer thread (``ShardReader`` reuses this
+    machinery under ``dml-shard-reader``)."""
     q: _queue.Queue = _queue.Queue(maxsize=max(num_elements, 1))
     stop = threading.Event()
     _END, _ERR = object(), object()
@@ -740,7 +858,7 @@ def _prefetch_iter(src: Iterator, num_elements: int) -> Iterator:
     # named so shutdown tests (and a forensics dump's thread list) can
     # identify host-prefetch threads; daemon so a full queue can never pin
     # process exit even if the consumer leaks the generator
-    thread = threading.Thread(target=produce, daemon=True, name="dml-host-prefetch")
+    thread = threading.Thread(target=produce, daemon=True, name=name)
     thread.start()
     try:
         while True:

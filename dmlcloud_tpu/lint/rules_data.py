@@ -1,4 +1,4 @@
-"""The packed-data-plane correctness rule (DML209).
+"""The data-plane rules (DML209, DML214).
 
 Packing (``DataPipeline.pack``/``pack_stream``, ``pack_sequences``,
 ``native.pack.pack_flat``) puts SEVERAL documents into one row; the row is
@@ -37,9 +37,9 @@ from __future__ import annotations
 import ast
 
 from . import dataflow
-from .engine import Finding, ModuleCtx, rule
+from .engine import Finding, ModuleCtx, rule, walk_fn
 
-__all__ = ["check_packed_segment_ids"]
+__all__ = ["check_packed_segment_ids", "check_blocking_data_io"]
 
 #: unambiguous packed-pipeline builders (free-function / terminal-attr form)
 _PACKER_NAMES = frozenset({"pack_stream", "pack_sequences", "pack_sequences_fast", "pack_flat"})
@@ -161,4 +161,56 @@ def check_packed_segment_ids(ctx: ModuleCtx):
                     "restart per segment — pass segment_ids so the packed row "
                     "computes exactly what the unpacked documents would",
                     scope_name,
+                )
+
+
+# ------------------------------------------------------------------- DML214
+
+#: module.attr loaders that read + deserialize a file in one blocking call
+_BLOCKING_LOADERS = frozenset({
+    "numpy.load",
+    "numpy.loadtxt",
+    "numpy.genfromtxt",
+    "numpy.fromfile",
+    "json.load",
+    "pickle.load",
+    "torch.load",
+})
+
+
+@rule("DML214", "blocking file I/O inside step/epoch code")
+def check_blocking_data_io(ctx: ModuleCtx):
+    """File reads on the training thread (``open().read()``, ``np.load``,
+    ``json.load``, ``pickle.load``) stall the dispatch queue for the full
+    disk round trip — per step, that is the difference between a compute-
+    bound run and a disk-bound one, and the telemetry ledger books it as
+    unexplained step time rather than ``data_wait``. The disk-native data
+    plane exists so this never happens on the hot path: build the corpus
+    offline (scripts/build_corpus.py), read it through the mmap'd
+    ``ShardReader`` (data/store.py — page faults land on the
+    ``dml-shard-reader`` thread), or, for genuinely unavoidable reads,
+    account them under ``StallTimer.measure()`` so the ledger sees them."""
+    for fn in ctx.step_fns + ctx.epoch_fns:
+        for node, in_measure in walk_fn(fn.node):
+            if in_measure or not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func) or ""
+            if isinstance(node.func, ast.Name) and resolved == "open":
+                yield Finding(
+                    "DML214", ctx.path, node.lineno, node.col_offset,
+                    f"open() inside {fn.kind} code blocks training on disk "
+                    "I/O; read through the mmap'd shard store "
+                    "(data/store.py ShardReader) or account the read under "
+                    "StallTimer.measure()",
+                    fn.qualname,
+                )
+            elif resolved in _BLOCKING_LOADERS:
+                yield Finding(
+                    "DML214", ctx.path, node.lineno, node.col_offset,
+                    f"{resolved}(...) inside {fn.kind} code reads and "
+                    "deserializes a file on the training thread; stage data "
+                    "through the disk-native shard format "
+                    "(scripts/build_corpus.py + ShardReader) or account it "
+                    "under StallTimer.measure()",
+                    fn.qualname,
                 )

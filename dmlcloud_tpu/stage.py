@@ -1003,6 +1003,9 @@ class TrainValStage(Stage):
         self._gp_data_wait_ns = 0
         self._gp_pad_slots = 0
         self._gp_token_slots = 0
+        from .data import store as _shard_store
+
+        self._gp_reader_mark = _shard_store.reader_activity()
         super()._pre_epoch()
 
     @property
@@ -1042,6 +1045,14 @@ class TrainValStage(Stage):
                     round(self._gp_pad_slots / self._gp_token_slots, 6),
                     reduction=Reduction.MEAN,
                     prefixed=False,
+                )
+            from .data import store as _shard_store
+
+            if _shard_store.reader_activity() > getattr(self, "_gp_reader_mark", 0):
+                # a ShardReader fetched blocks this epoch — the goodput
+                # advisor points at reader knobs instead of generic prefetch
+                self.track_reduce(
+                    "misc/shard_reader", 1.0, reduction=Reduction.MAX, prefixed=False
                 )
         if self._train_compiled is not None:
             # signatures that showed up this epoch WITHOUT a precompiled

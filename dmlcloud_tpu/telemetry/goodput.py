@@ -41,6 +41,7 @@ _EPOCH_METRICS = {
     "misc/goodput": "goodput",
     "misc/mfu": "mfu",
     "misc/pad_fraction": "pad_fraction",
+    "misc/shard_reader": "shard_reader",
 }
 
 #: data_wait share of an epoch above which the advisor speaks up
@@ -136,7 +137,12 @@ def advise_rows(rows: list[dict]) -> list[str]:
     of an epoch's wall time, the input pipeline — not the device —
     is the bottleneck, and the fix is a concrete knob:
 
-    - raise ``prefetch(n)`` / ``prefetch_depth()`` or enable
+    - when a disk ``ShardReader`` fed the starved epochs
+      (``misc/shard_reader`` tracked), the reader itself is the knob:
+      raise its ``buffers=`` / ``read_ahead=`` so more blocks are in
+      flight — a generic downstream ``prefetch()`` would only move the
+      same starvation one stage later,
+    - otherwise raise ``prefetch(n)`` / ``prefetch_depth()`` or enable
       ``host_prefetch()`` so host batch prep overlaps the step, and
     - when the batches carry a pad mask (``misc/pad_fraction`` tracked,
       i.e. ``segment_ids`` mark wasted slots), enable
@@ -158,13 +164,24 @@ def advise_rows(rows: list[dict]) -> list[str]:
         default=0.0,
     )
     epochs = ", ".join(str(e) for e in starved[:8]) + ("…" if len(starved) > 8 else "")
-    advice = [
-        f"data_wait exceeded {_ADVISE_DATA_WAIT_FRAC:.0%} of epoch time in "
-        f"epoch(s) {epochs} (worst {worst:.0%}): the input pipeline is "
-        "starving the device — raise the pipeline's prefetch(n) / the stage's "
-        "prefetch_depth(), or enable host_prefetch() to move batch prep off "
-        "the training thread (doc/performance.md §3)"
-    ]
+    shard_fed = any(r.get("shard_reader") for r in rows if r["epoch"] in starved)
+    if shard_fed:
+        advice = [
+            f"data_wait exceeded {_ADVISE_DATA_WAIT_FRAC:.0%} of epoch time in "
+            f"epoch(s) {epochs} (worst {worst:.0%}) with a disk ShardReader "
+            "feeding the run: the reader is the starved stage — raise its "
+            "buffers= (blocks in flight) and/or read_ahead= (records per "
+            "block) so cold-disk page faults stay ahead of the step "
+            "(doc/data.md, On-disk shard format)"
+        ]
+    else:
+        advice = [
+            f"data_wait exceeded {_ADVISE_DATA_WAIT_FRAC:.0%} of epoch time in "
+            f"epoch(s) {epochs} (worst {worst:.0%}): the input pipeline is "
+            "starving the device — raise the pipeline's prefetch(n) / the stage's "
+            "prefetch_depth(), or enable host_prefetch() to move batch prep off "
+            "the training thread (doc/performance.md §3)"
+        ]
     pads = [r["pad_fraction"] for r in rows if r.get("pad_fraction") is not None]
     if pads and max(pads) > _ADVISE_PAD_FRAC:
         advice.append(
@@ -198,6 +215,7 @@ def ledger_from_tracker(tracker) -> GoodputLedger:
             "goodput": _get(tracker, "misc/goodput", i),
             "mfu": _get(tracker, "misc/mfu", i),
             "pad_fraction": _get(tracker, "misc/pad_fraction", i),
+            "shard_reader": _get(tracker, "misc/shard_reader", i),
         }
         # host_stall bucket excludes the checkpoint share (disjoint buckets)
         if stall_ms is not None:

@@ -56,6 +56,7 @@ MODULES = [
     ("dmlcloud_tpu.serve.chaos", "Seeded, replayable fault injection for serving drills."),
     ("dmlcloud_tpu.serve.router", "Multi-replica front door: health-checked routing, failover, drain."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
+    ("dmlcloud_tpu.data.store", "Disk-native data plane: mmap'd .dmlshard corpora + async ShardReader."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),
     ("dmlcloud_tpu.data.device", "Host-to-device batch transfer."),
     ("dmlcloud_tpu.utils.config", "Config container with interpolation."),

@@ -1,22 +1,28 @@
 #!/usr/bin/env python
-"""Data-plane A/B receipt: the streaming packed input path
-(``DataPipeline.mix -> pack_stream -> batch``) vs pad-to-max on the pinned
-ragged corpus (doc/data.md):
+"""Data-plane A/B receipt: pad-to-max vs the streaming packed input path
+(``DataPipeline.mix -> pack_stream -> batch``) vs the DISK-NATIVE path
+(``ShardReader -> pack_stream(pack_window=...)``) on the pinned ragged
+corpus (doc/data.md):
 
 - real (non-padding) tokens/s through the SAME TrainValStage train step
-  for both arms — the pad arm burns ~3/4 of every batch on padding, the
-  packed arm reclaims it
-- padding-waste fraction before vs after, with the chunk-boundary share
-  reported separately (the part a larger ``chunk_docs`` would reclaim)
-- data_wait_s from the telemetry ledger and 0 mid-run recompiles (packed
-  rows are fixed-shape by construction; AOT-precompiled signature)
+  for all three arms — the pad arm burns ~3/4 of every batch on padding,
+  the packed arm reclaims it, and the disk arm reads the same documents
+  COLD from a temp ``.dmlshard`` corpus through the async mmap reader
+  while the window-FFD packer cuts pad_fraction under 1%
+- padding-waste fraction per arm, with the boundary share reported
+  separately (chunk tails for greedy; end-of-stream flush only for FFD)
+- data_wait_s from the telemetry ledger, 0 mid-run recompiles (packed
+  rows are fixed-shape by construction; AOT-precompiled signature), and
+  the reshard replay drill: a 4-reader cursor saved mid-corpus and
+  resumed by 2 readers must cover every record exactly once
+  (``data_disk_zero_replay``)
 
 Thin CLI over ``bench.bench_data`` (which runs ``bench.py --data-child``
 CPU-pinned) so the committed receipt and an interactive investigation run
 the exact same workload. The receipt's flat ``gate`` section is what
 ``bench.py --gate --suite data`` / scripts/perf_gate.sh compares.
 
-    JAX_PLATFORMS=cpu python scripts/bench_data.py --out BENCH_data_pr09.json
+    JAX_PLATFORMS=cpu python scripts/bench_data.py --out BENCH_data_pr18.json
 """
 
 import argparse

@@ -1,0 +1,33 @@
+"""DML214 bad fixture: blocking file I/O on the training thread — disk
+round trips inside step/epoch code that the telemetry ledger can't see.
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+import json
+import pickle
+
+import numpy as np
+
+from dmlcloud_tpu.stage import TrainValStage
+
+
+class DiskBoundStage(TrainValStage):
+    def step(self, state, batch):
+        extra = np.load(self.aux_path)  # BAD: deserializes a file every step
+        with open(self.meta_path) as f:  # BAD: disk read on the hot path
+            meta = json.loads(f.read())
+        return self.loss(state, batch, extra, meta)
+
+    def train_epoch(self):
+        table = json.load(self.table_file)  # BAD: blocking load in the epoch loop
+        for batch in self.train_loader:
+            self.step(self.state, batch)
+        return table
+
+
+class PickledCurriculum(TrainValStage):
+    def run_epoch(self):
+        plan = pickle.load(self.plan_file)  # BAD: unpickling inside the epoch loop
+        for batch in self.loader:
+            self.apply_plan(plan, batch)

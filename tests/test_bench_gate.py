@@ -667,6 +667,10 @@ DATA_RECEIPT = {
         "data_padding_waste_reclaimed": 0.5,
         "data_zero_recompiles": 1.0,
         "data_wait_s": 0.04,
+        "data_disk_tokens_per_sec": 7500.0,
+        "data_disk_pad_fraction": 0.005,
+        "data_disk_wait_s": 0.04,
+        "data_disk_zero_replay": 1.0,
     },
 }
 
@@ -721,6 +725,52 @@ def test_data_missing_metric_fails(tmp_path, capsys):
     assert "MISSING" in capsys.readouterr().out
 
 
+def test_data_disk_throughput_regression_fails(tmp_path, capsys):
+    """A disk arm that stopped keeping up (reader starving the step, mmap
+    path gone cold) FAILS on data_disk_tokens_per_sec."""
+    doctored = json.loads(json.dumps(DATA_RECEIPT))
+    doctored["gate"]["data_disk_tokens_per_sec"] = 4000.0
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "data_disk_tokens_per_sec" in capsys.readouterr().out
+
+
+def test_data_disk_pad_fraction_is_lower_is_better(tmp_path, capsys):
+    """Pad fraction growing back toward the greedy packer's 19% is the FFD
+    win silently regressing — growth fails, shrinking passes."""
+    worse = json.loads(json.dumps(DATA_RECEIPT))
+    worse["gate"]["data_disk_pad_fraction"] = 0.15  # FFD win regressed away
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=worse) == 1
+    assert "data_disk_pad_fraction" in capsys.readouterr().out
+    better = json.loads(json.dumps(DATA_RECEIPT))
+    better["gate"]["data_disk_pad_fraction"] = 0.001
+    assert run_gate(base, current=better) == 0
+
+
+def test_data_disk_replay_failure_fails(tmp_path, capsys):
+    """The reshard replay drill reporting even one replayed/skipped record
+    (data_disk_zero_replay 0.0) is a 100% drop — always FAIL."""
+    doctored = json.loads(json.dumps(DATA_RECEIPT))
+    doctored["gate"]["data_disk_zero_replay"] = 0.0
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=doctored) == 1
+    assert "data_disk_zero_replay" in capsys.readouterr().out
+
+
+def test_data_missing_disk_metric_fails(tmp_path, capsys):
+    """A receipt that silently drops the disk keys (bench arm deleted,
+    marker renamed) FAILS — PR-6 missing-metric semantics cover the new
+    keys too."""
+    current = json.loads(json.dumps(DATA_RECEIPT))
+    for k in list(current["gate"]):
+        if k.startswith("data_disk_"):
+            del current["gate"][k]
+    base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
 def test_gate_main_data_suite_with_explicit_files(tmp_path):
     base = _write(tmp_path, "BENCH_data_base.json", DATA_RECEIPT)
     cur = _write(tmp_path, "cur.json", DATA_RECEIPT)
@@ -749,6 +799,39 @@ def test_committed_data_receipt_satisfies_the_gate():
     pad_tok = receipt["pad_to_max"]["real_tokens_per_epoch"]
     packed_tok = receipt["packed_stream"]["real_tokens_per_epoch"]
     assert abs(pad_tok - packed_tok) / pad_tok < 0.1
+
+
+def test_committed_disk_receipt_satisfies_the_gate():
+    """The committed PR 18 receipt: the COLD-DISK arm beats the same-box
+    in-memory greedy arm on real tokens/s (the mmap+read-ahead path costs
+    nothing the FFD packing win doesn't repay), FFD holds pad_fraction at
+    or under the 0.10 acceptance target (vs ~0.19 greedy), the 4->2
+    reshard replay drill reports exactly zero replayed/skipped records,
+    no arm recompiled mid-run, data_wait stays flat vs the in-memory arm,
+    and the receipt carries the host fingerprint that scopes its absolute
+    numbers to the box they were measured on."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_data_pr18.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    # disk-native floor: cold disk >= the in-memory packed arm, same box
+    assert gate["data_disk_tokens_per_sec"] >= gate["data_packed_tokens_per_sec"]
+    assert gate["data_disk_pad_fraction"] <= 0.10
+    assert gate["data_disk_zero_replay"] == 1.0
+    assert gate["data_zero_recompiles"] == 1.0
+    assert receipt["disk_stream"]["recompiles"] == 0
+    # data_wait flat: the reader's read-ahead keeps disk latency off the
+    # training thread (within 2x of the in-memory arm's wait)
+    assert gate["data_disk_wait_s"] <= 2.0 * gate["data_wait_s"]
+    # end-of-stream flush is the ONLY boundary padding in FFD mode
+    pack = receipt["disk_stream"]["pack"]
+    assert pack["boundary_pad_slots"] == pack["pad_slots"]
+    # absolute tokens/s are scoped to a box: the fingerprint must be there
+    assert set(receipt["host"]) >= {"cpu_count", "platform", "python"}
+    assert receipt["value_source"] == "cpu_smoke"
     # the boundary loss is reported and small relative to total padding
     pack = receipt["packed_stream"]["pack"]
     assert 0.0 <= pack["boundary_fraction"] <= pack["pad_fraction"]

@@ -657,3 +657,120 @@ class TestMixElasticContract:
         np.testing.assert_allclose(
             np.asarray(stage2.state.params["w"]), want, rtol=1e-5, atol=1e-6
         )
+
+
+class _BatchShardReader:
+    """Drill dataset over ON-DISK shards: a ShardReader whose records are
+    batch indices, mapped to the drill's real batches at yield time — so
+    the registered dataset IS the shard reader (the sidecar saves ITS
+    ``kind='shards'`` cursor), while the step still sees dict batches.
+    Optionally delivers a real SIGTERM after batch K (the _SigtermAfter
+    pattern)."""
+
+    def __new__(cls, corpus_dir, batches, kill_after=None):
+        from dmlcloud_tpu.data import ShardReader
+
+        class _Reader(ShardReader):
+            def _shard_iter(self, epoch):
+                for i, rec in enumerate(super()._shard_iter(epoch)):
+                    yield batches[int(rec[0])]
+                    if kill_after is not None and not getattr(self, "_fired", False) and i + 1 == kill_after:
+                        self._fired = True
+                        os.kill(os.getpid(), signal.SIGTERM)
+
+        return _Reader(corpus_dir, read_ahead=4)
+
+
+class TestShardElasticContract:
+    def _corpus(self, tmp_path, n=40):
+        from dmlcloud_tpu.data import build_corpus
+
+        d = tmp_path / "corpus"
+        docs = [np.full(3, i, np.int32) for i in range(n)]
+        build_corpus(d, docs, shard_tokens=9)  # 3 records/shard -> many shards
+        return str(d), docs
+
+    def test_world_size_change_scales_shard_cursor(self, tmp_path, single_runtime, monkeypatch):
+        """Save under world size 4, resume under 2: the shard cursor is a
+        global record offset plus its (shard_id, record_offset) disk
+        location, and the resume SEEKS — no pending replay skip."""
+        from dmlcloud_tpu.data import ShardReader
+
+        d, docs = self._corpus(tmp_path)
+        monkeypatch.setattr(runtime, "world_size", lambda: 4)
+        reader = ShardReader(d)
+        it = iter(reader)
+        consumed = [next(it) for _ in range(3)]
+        assert all(np.array_equal(a, docs[i * 4]) for i, a in enumerate(consumed))
+        state = reader.state_dict()
+        it.close()
+        assert state["kind"] == "shards" and state["world_size"] == 4
+        assert state["global_offset"] == 12
+        # record 12 sits in shard 4 at offset 0 (3 records per shard)
+        assert (state["shard_id"], state["record_offset"]) == (4, 0)
+
+        monkeypatch.setattr(runtime, "world_size", lambda: 2)
+        fresh = ShardReader(d)
+        fresh.load_state_dict(state)
+        # per-rank cursor under the NEW world size: global / 2, via seek
+        assert fresh._shard_resume == 6
+        assert fresh._pending_skip == 0
+        it = iter(fresh)
+        assert np.array_equal(next(it), docs[12])  # rank 0: g = 0 + 6*2
+        # and the resumed cursor continues globally
+        assert fresh.state_dict()["global_offset"] == 12 + 2
+        it.close()
+
+    def test_indivisible_shard_cursor_warns_and_rounds_down(self, tmp_path, single_runtime, monkeypatch, caplog):
+        from dmlcloud_tpu.data import ShardReader
+
+        d, _ = self._corpus(tmp_path)
+        monkeypatch.setattr(runtime, "world_size", lambda: 4)
+        reader = ShardReader(d)
+        it = iter(reader)
+        for _ in range(3):
+            next(it)
+        state = reader.state_dict()  # 12 global
+        it.close()
+        monkeypatch.setattr(runtime, "world_size", lambda: 5)
+        fresh = ShardReader(d)
+        with caplog.at_level("WARNING", logger="dmlcloud_tpu"):
+            fresh.load_state_dict(state)
+        assert fresh._shard_resume == 2  # 12 // 5
+        assert any("not divisible" in r.message for r in caplog.records)
+
+    def test_drill_with_shard_reader(self, tmp_path, single_runtime):
+        """The preemption drill fed from DISK: batches come through a
+        ShardReader over a multi-shard corpus, SIGTERM lands mid-epoch,
+        the run drains at the save boundary with the 'shards' cursor in
+        the sidecar, and the resume on a smaller mesh finishes with
+        parameters matching the uninterrupted control — 0 replayed or
+        skipped samples, resumed by SEEK instead of replay."""
+        batches = _drill_batches()
+        d, _ = self._corpus(tmp_path, n=N_BATCHES)  # record i -> batch i
+
+        _, control = _drill_run(tmp_path / "control", _BatchShardReader(d, batches), 2)
+        want = np.asarray(control.state.params["w"])
+        assert int(control.state.step) == 2 * N_BATCHES
+
+        pipe1, stage1 = _drill_run(
+            tmp_path / "run", _BatchShardReader(d, batches, kill_after=3), 4, preemptible=True
+        )
+        assert stage1._mid_epoch_exit
+        drained = int(stage1.state.step)
+        assert 0 < drained < N_BATCHES and drained % SAVE_EVERY == 0
+        meta = json.loads(
+            (pipe1.checkpoint_dir.path / "meta" / "stage.steps" / f"{drained}.json").read_text()
+        )
+        assert meta["data"]["kind"] == "shards"
+        assert meta["data"]["global_offset"] == drained
+        # the sidecar names the disk location the resume will seek to
+        assert (meta["data"]["shard_id"], meta["data"]["record_offset"]) == divmod(drained, 3)
+
+        pipe2, stage2 = _drill_run(pipe1.checkpoint_dir.path, _BatchShardReader(d, batches), 2)
+        # exact resumption: 2 epochs x 10 disk batches, not one step more
+        # or less — a replayed or skipped record cannot produce step == 20
+        assert int(stage2.state.step) == 2 * N_BATCHES
+        np.testing.assert_allclose(
+            np.asarray(stage2.state.params["w"]), want, rtol=1e-5, atol=1e-6
+        )

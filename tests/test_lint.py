@@ -49,6 +49,7 @@ BAD_EXPECT = {
     "DML211": 4,
     "DML212": 4,
     "DML213": 4,
+    "DML214": 4,
     "DML301": 2,
     "DML302": 2,
 }

@@ -546,7 +546,7 @@ class TestWatchdog:
 
 
 class TestGoodputAdvisor:
-    def _row(self, epoch, epoch_s, data_wait_s, pad_fraction=None):
+    def _row(self, epoch, epoch_s, data_wait_s, pad_fraction=None, shard_reader=None):
         return {
             "epoch": epoch,
             "epoch_s": epoch_s,
@@ -557,6 +557,7 @@ class TestGoodputAdvisor:
             "goodput": None,
             "mfu": None,
             "pad_fraction": pad_fraction,
+            "shard_reader": shard_reader,
         }
 
     def test_quiet_below_the_threshold(self):
@@ -582,6 +583,41 @@ class TestGoodputAdvisor:
         # a mask with little padding does not trigger the packing advice
         advice = advise_rows([self._row(1, 10.0, 4.0, pad_fraction=0.05)])
         assert len(advice) == 1
+
+    def test_shard_reader_starvation_targets_the_reader_knobs(self):
+        """When a disk ShardReader fed the starved epochs, the advice names
+        the reader's own knobs — buffers= / read_ahead= — INSTEAD of the
+        generic downstream prefetch row (which would only move the same
+        starvation one stage later)."""
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        advice = advise_rows([self._row(1, 10.0, 4.5, shard_reader=1.0)])
+        assert len(advice) == 1
+        assert "ShardReader" in advice[0]
+        assert "buffers=" in advice[0] and "read_ahead=" in advice[0]
+        assert "host_prefetch" not in advice[0]
+
+    def test_shard_reader_in_healthy_epoch_keeps_generic_advice(self):
+        """The reader advice keys off the STARVED epochs: a ShardReader that
+        fed only well-overlapped epochs doesn't hijack the generic row."""
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        rows = [
+            self._row(1, 10.0, 0.2, shard_reader=1.0),  # healthy, reader-fed
+            self._row(2, 10.0, 4.5),  # starved, generic iterable
+        ]
+        advice = advise_rows(rows)
+        assert len(advice) == 1
+        assert "host_prefetch" in advice[0]
+        assert "ShardReader" not in advice[0]
+
+    def test_shard_reader_advice_composes_with_pad_advice(self):
+        from dmlcloud_tpu.telemetry.goodput import advise_rows
+
+        advice = advise_rows([self._row(1, 10.0, 4.0, pad_fraction=0.4, shard_reader=1.0)])
+        assert len(advice) == 2
+        assert "read_ahead=" in advice[0]
+        assert "pack_stream" in advice[1]
 
     def test_ledger_advise_delegates(self):
         from dmlcloud_tpu.telemetry.goodput import GoodputLedger, advise_rows
