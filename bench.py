@@ -1928,6 +1928,246 @@ def bench_serve(timeout_s: int = 1200) -> dict | None:
     return None
 
 
+# ---------------------------------------------------- observability bench
+
+_OBS_MARKER = "OBS_BENCH_RESULTS "
+
+#: the observability-overhead A/B config (ISSUE 19) — pinned so receipts
+#: stay comparable. The instrumented arm arms EVERYTHING at once (span
+#: journal, metrics registry, SLO monitors) against the bare engine on
+#: the SAME pinned Poisson trace as the serve A/B; best-of-N replays per
+#: arm because a single CPU replay carries ~5% scheduler noise, which
+#: would drown the ≤3% budget the gate enforces.
+_OBS_CFG = dict(best_of=3, overhead_budget_frac=0.03)
+
+
+def _obs_replay_best(engine, trace, best_of):
+    """Replay the pinned trace ``best_of`` times on an already-warmed
+    engine (ledger reset between replays) and return the best
+    tokens_per_sec — the noise-robust throughput estimate of one arm."""
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+
+    best = 0.0
+    for _ in range(best_of):
+        engine.ledger = ServeLedger()
+        summary = engine.serve_trace(trace)
+        best = max(best, float(summary["tokens_per_sec"]))
+    return best
+
+
+def _obs_overhead_section():
+    """The tracing+metrics+SLO overhead A/B: two engines over the pinned
+    Poisson serve trace — one bare, one with the full observability plane
+    armed (journal spans flushing off-thread, metrics registry hot-path
+    counters/histograms, SLO monitors evaluated every step). Returns the
+    overhead fraction the ≤3% gate budget applies to."""
+    import tempfile
+
+    from dmlcloud_tpu.serve import SLO, ServeEngine
+    from dmlcloud_tpu.telemetry import journal as tj
+    from dmlcloud_tpu.telemetry.metrics_registry import parse_prometheus_text
+
+    c, oc = _SERVE_CFG, _OBS_CFG
+    model, params = _serve_model()
+    trace = _serve_trace()
+    warm = [(0.0, p, n) for _, p, n in trace]
+    kwargs = dict(
+        num_blocks=c["num_blocks"], block_size=c["block_size"],
+        max_slots=c["max_slots"], prefill_chunk=c["prefill_chunk"],
+    )
+
+    bare = ServeEngine(model, params, **kwargs)
+    bare.serve_trace(warm)
+    bare_tps = _obs_replay_best(bare, trace, oc["best_of"])
+
+    run_dir = tempfile.mkdtemp(prefix="bench_obs_")
+    j = tj.SpanJournal(os.path.join(run_dir, "telemetry"))
+    j.start()
+    tj.activate(j)
+    try:
+        instr = ServeEngine(
+            model, params, metrics=True,
+            slos=[SLO("bench-ttft", ttft_p99_s=30.0, availability=0.5)],
+            **kwargs,
+        )
+        instr.serve_trace(warm)
+        instr_tps = _obs_replay_best(instr, trace, oc["best_of"])
+        metrics_text = instr.metrics_text()
+    finally:
+        tj.deactivate()
+        j.close()
+
+    try:
+        families = parse_prometheus_text(metrics_text)
+        engine_metrics_valid = bool(families)
+    except ValueError:
+        engine_metrics_valid = False
+    spans = j.tail(10 ** 6)
+    overhead = max(0.0, (bare_tps - instr_tps) / bare_tps) if bare_tps else 1.0
+    return {
+        "config": dict(oc),
+        "bare_tokens_per_sec": round(bare_tps, 1),
+        "instrumented_tokens_per_sec": round(instr_tps, 1),
+        "overhead_frac": round(overhead, 4),
+        "spans_journaled": len(spans),
+        "engine_metrics_valid": engine_metrics_valid,
+        "leaked_blocks": int(instr.leaked_blocks()),
+    }
+
+
+def _obs_router_trace_drill():
+    """The linked-trace drill: the SAME kill-one-drain-one router drill as
+    ``_serve_router_section`` but with the span journal armed, so every
+    span each request touches — across replicas, failover retries, and
+    the drained replica's handoff — is journaled. The gate key is binary:
+    every logical request resolves to exactly ONE trace id and the
+    journal walk finds ZERO orphan request-scoped spans. Also scrapes
+    ``Router.metrics_text()`` and validates it as Prometheus text."""
+    import tempfile
+
+    from dmlcloud_tpu.serve import Router, ServeEngine, TERMINAL_STATUSES
+    from dmlcloud_tpu.serve.ledger import ServeLedger
+    from dmlcloud_tpu.telemetry import journal as tj
+    from dmlcloud_tpu.telemetry.journal import linked_trace_report
+    from dmlcloud_tpu.telemetry.metrics_registry import parse_prometheus_text
+
+    c, sc = _SERVE_ROUTER_CFG, _SERVE_CFG
+    model, params = _serve_model()
+    trace = _serve_router_trace()
+    n = len(trace)
+    warm = [(0.0, p, new) for _, p, new, _ in trace]
+
+    engines = []
+    for _ in range(c["n_replicas"]):
+        eng = ServeEngine(
+            model, params, metrics=True,
+            num_blocks=sc["num_blocks"], block_size=sc["block_size"],
+            max_slots=sc["max_slots"], prefill_chunk=sc["prefill_chunk"],
+        )
+        eng.serve_trace(warm)
+        eng.ledger = ServeLedger()
+        engines.append(eng)
+
+    run_dir = tempfile.mkdtemp(prefix="bench_obs_router_")
+    j = tj.SpanJournal(os.path.join(run_dir, "telemetry"))
+    j.start()
+    tj.activate(j)
+    try:
+        router = Router(
+            engines,
+            heartbeat_timeout_s=c["heartbeat_timeout_s"],
+            max_retries=c["max_retries"], backoff_base_s=c["backoff_base_s"],
+            run_dir=run_dir,
+        )
+        fired = {"kill": False, "drain": False}
+
+        def controller(point, seqs):
+            if point != "router_step":
+                return
+            done = sum(
+                1 for s in router.statuses().values() if s in TERMINAL_STATUSES
+            )
+            if not fired["kill"] and done >= c["kill_after_done"]:
+                fired["kill"] = True
+                router.kill_replica(c["kill_replica"], reason="obs drill")
+            if not fired["drain"] and done >= c["drain_after_done"]:
+                fired["drain"] = True
+                router.drain_replica(c["drain_replica"], reason="obs drill")
+
+        router.fault_injector = controller
+        router.serve_trace(trace)
+        metrics_text = router.metrics_text()
+    finally:
+        tj.deactivate()
+        j.close()
+
+    records = tj.load_journals(run_dir)
+    report = linked_trace_report(records)
+    expected = {f"tr-{rid}" for rid in range(n)}
+    linked = (
+        not report["orphans"]
+        and expected <= set(report["traces"])
+        and all(report["traces"][t] for t in expected)
+    )
+    try:
+        families = parse_prometheus_text(metrics_text)
+        metrics_valid = bool(families)
+    except ValueError:
+        families, metrics_valid = {}, False
+    statuses = [router.status(rid) for rid in range(n)]
+    return {
+        "requests": n,
+        "kill_fired": fired["kill"],
+        "drain_fired": fired["drain"],
+        "failovers": int(router.failovers),
+        "spans_journaled": len(records),
+        "traces": len(report["traces"]),
+        "orphan_spans": len(report["orphans"]),
+        "trace_linked": bool(linked),
+        "all_terminal": all(s in TERMINAL_STATUSES for s in statuses),
+        "leaked_blocks": int(router.leaked_blocks()),
+        "metrics_families": len(families),
+        "metrics_valid": bool(metrics_valid),
+    }
+
+
+def obs_child_main():
+    """A/B the observability plane's overhead (journal + metrics + SLO
+    armed vs bare engine on the pinned Poisson trace), then the
+    journal-armed kill-one-drain-one router drill proving every span
+    links into exactly one per-request trace with zero orphans, then
+    Prometheus-exposition validity (CPU-pinned child); prints one marker
+    line of JSON — the source of ``BENCH_obs_*.json`` and of the
+    ``--suite serve`` merged gate's obs keys."""
+    jax.config.update("jax_platforms", "cpu")
+
+    overhead = _obs_overhead_section()
+    drill = _obs_router_trace_drill()
+    results = {
+        "config": {**_OBS_CFG, "serve": dict(_SERVE_CFG)},
+        "value_source": "cpu_smoke",
+        "host": _host_fingerprint(),
+        "overhead": overhead,
+        "router_drill": drill,
+        # the flat, schema-stable section the perf gate compares: the
+        # overhead fraction is lower-is-better (≤3% budget locked by the
+        # committed-receipt test), linkage + exposition are pass/fail ints
+        "gate": {
+            "obs_overhead_frac": overhead["overhead_frac"],
+            "obs_trace_linked": int(bool(drill["trace_linked"])),
+            "obs_metrics_valid": int(
+                bool(drill["metrics_valid"]) and bool(overhead["engine_metrics_valid"])
+            ),
+        },
+    }
+    print(_OBS_MARKER + json.dumps(results), flush=True)
+
+
+def bench_obs(timeout_s: int = 1200) -> dict | None:
+    """Run the observability overhead A/B + linked-trace drill in a
+    CPU-pinned child; returns its results dict, or None on failure."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--obs-child"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(_OBS_MARKER):
+            try:
+                return json.loads(line[len(_OBS_MARKER):])
+            except ValueError:
+                return None
+    return None
+
+
 # ------------------------------------------------------- data plane bench
 
 _DATA_MARKER = "DATA_BENCH_RESULTS "
@@ -2405,6 +2645,7 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "data_wait_s",
         "data_disk_wait_s",
         "data_disk_pad_fraction",
+        "obs_overhead_frac",
         "tier1_suite_wall_s",
         "lint_cold_wall_s",
         "lint_warm_wall_s",
@@ -2577,7 +2818,11 @@ def gate_main(argv: list) -> int:
     keys, the ``serve_prefix_*`` sharing keys, the ``serve_chaos_*``
     robustness keys and the ``serve_router_*`` failover/drain keys —
     latencies judged lower-is-better; every receipt's keys stay enforced,
-    so a silently-vanished metric FAILS); the ``data`` suite replays the streaming
+    so a silently-vanished metric FAILS — and, when a committed
+    ``BENCH_obs_*.json`` exists, the observability child runs too and its
+    ``obs_overhead_frac`` (lower-is-better, ≤3% budget) /
+    ``obs_trace_linked`` / ``obs_metrics_valid`` keys merge into the same
+    comparison); the ``data`` suite replays the streaming
     packed-vs-pad-to-max A/B plus the disk arm against EVERY committed
     ``BENCH_data_*.json`` merged into one baseline (packed tokens/s
     speedup, padding waste reclaimed, 0 mid-run recompiles, data_wait as
@@ -2692,13 +2937,33 @@ def gate_main(argv: list) -> int:
             # a silently-vanished serve_prefix_* (or serve_medusa_*) metric
             # FAILS while an older receipt's stale absolute numbers (e.g.
             # pr08's tokens/s from a different box era) do not resurrect as
-            # floors (_merged_baseline).
-            baseline = _merged_baseline(["BENCH_serve_*.json"])
+            # floors (_merged_baseline). PR 19's observability receipts
+            # (BENCH_obs_*.json: obs_overhead_frac / obs_trace_linked /
+            # obs_metrics_valid) merge into the SAME baseline, so a
+            # vanished obs key fails the serve suite too.
+            baseline = _merged_baseline(["BENCH_serve_*.json", "BENCH_obs_*.json"])
             if baseline is None:
                 print("gate: FAIL — no --baseline and no committed BENCH_serve_*.json", file=sys.stderr)
                 return 2
         current = _opt("--current") if suite == "serve" else None
-        if current is None:
+        if current is None and (
+            not isinstance(baseline, dict) or any(
+                k.startswith("obs_") for k in baseline["gate"]
+            )
+        ):
+            # the merged baseline carries obs_* keys, so the current run
+            # must produce them too: both CPU-pinned children run and
+            # their gate sections merge (missing either child = FAIL)
+            print("gate: running the serving A/B (serve suite child)...", file=sys.stderr)
+            cur_s = bench_serve()
+            print("gate: running the observability A/B (obs suite child)...", file=sys.stderr)
+            cur_o = bench_obs()
+            if cur_s is None or cur_o is None:
+                which = "serve" if cur_s is None else "obs"
+                print(f"gate: FAIL — {which} bench child produced no results", file=sys.stderr)
+                return 2
+            current = {"gate": {**_gate_metrics(cur_s), **_gate_metrics(cur_o)}}
+        elif current is None:
             print("gate: running the serving A/B (serve suite child)...", file=sys.stderr)
             current = bench_serve()
             if current is None:
@@ -3835,6 +4100,8 @@ if __name__ == "__main__":
         elastic_child_main()
     elif "--serve-child" in sys.argv[1:]:
         serve_child_main()
+    elif "--obs-child" in sys.argv[1:]:
+        obs_child_main()
     elif "--data-child" in sys.argv[1:]:
         data_child_main()
     elif "--train-quant-child" in sys.argv[1:]:

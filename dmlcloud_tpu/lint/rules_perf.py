@@ -41,6 +41,17 @@ contracts that keep them fast checkable on CPU:
           an empty queue) parks the loop forever, so heartbeat deadlines
           are never checked and every replica behind the router looks
           dead at once
+- DML215  unbounded metric label cardinality: a ``.labels(...)`` call in
+          a per-request/per-step loop whose label value resolves to a
+          request id / idempotency token / trace id (one SERIES minted
+          per request — memory grows with traffic forever), or a
+          registry ``counter()``/``gauge()``/``histogram()`` create in a
+          loop with a per-request dynamic NAME (one FAMILY per request).
+          Flow-aware: a bare name is chased to its binding. Resolve the
+          series handle once outside the loop and key labels by a
+          bounded vocabulary (status, replica, tenant tier) — the
+          registry's ``max_series`` overflow valve is a backstop, not a
+          design (telemetry/metrics_registry.py)
 
 Both are flow-aware (built on lint/dataflow.py): DML205 only fires when
 the state argument provably FLOWS TO THE RETURN (a read-only cache in a
@@ -79,6 +90,7 @@ __all__ = [
     "check_unguarded_shared_block_write",
     "check_leaky_failure_handler",
     "check_unbounded_blocking_receive",
+    "check_metric_label_cardinality",
 ]
 
 
@@ -993,3 +1005,128 @@ def check_unbounded_blocking_receive(ctx: ModuleCtx):
             f"look dead; bound it ({remedy})",
             getattr(fn, "name", ""),
         )
+
+
+# ------------------------------------------------------------------- DML215
+
+#: identifiers that name a PER-REQUEST value — the label values that mint
+#: one metric series per request. Deliberately excludes plurals and
+#: generic words ("tokens" is a token array, "name" a replica name).
+_REQUEST_ID_STEM = re.compile(
+    r"(?i)(^|_)(rid|req|request|token|trace|uuid|session)(_?ids?)?(_|$)"
+)
+
+#: registry factory methods that create a metric family
+_METRIC_CREATE_ATTRS = frozenset({"counter", "gauge", "histogram"})
+
+#: what a metric-registry receiver looks like (``reg.counter(...)``,
+#: ``self.metrics.histogram(...)``) — scopes the create-in-loop check so
+#: ``np.histogram(request_latencies)`` in a loop can never match
+_REGISTRY_RECV = re.compile(r"(?i)(^|_)(registry|metrics|meter|reg)$")
+
+
+def _request_idish(expr: ast.AST, scopes) -> bool:
+    """``expr`` carries a per-request identifier: a name/attribute in the
+    request-id vocabulary, a constant-string subscript key in it
+    (``rec["request_id"]``), an f-string interpolating one — or, flow-
+    aware, a bare name BOUND to any of those through the dataflow core."""
+
+    def direct(e: ast.AST) -> bool:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Name) and _REQUEST_ID_STEM.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) and _REQUEST_ID_STEM.search(sub.attr):
+                return True
+            if (
+                isinstance(sub, ast.Subscript)
+                and isinstance(sub.slice, ast.Constant)
+                and isinstance(sub.slice.value, str)
+                and _REQUEST_ID_STEM.search(sub.slice.value)
+            ):
+                return True
+        return False
+
+    if direct(expr):
+        return True
+    if isinstance(expr, ast.Name):
+        bound = dataflow.resolve_expr(expr, scopes)
+        if bound is not None and bound is not expr:
+            return direct(bound)
+    return False
+
+
+@rule("DML215", "unbounded metric label cardinality in a per-request loop")
+def check_metric_label_cardinality(ctx: ModuleCtx):
+    """A metrics series minted PER REQUEST: ``family.labels(...)`` inside
+    a ``for``/``while`` body with a label value that resolves to a
+    request id / idempotency token / trace id, or a registry
+    ``counter()``/``gauge()``/``histogram()`` call in a loop whose metric
+    NAME is built from one (an f-string per request = one family per
+    request). Either way the registry grows with traffic and never
+    shrinks — the OOM that surfaces three weeks into a deployment, and
+    exactly what the registry's ``max_series`` overflow collapse exists
+    to contain (telemetry/metrics_registry.py; the engine pre-binds every
+    series handle in ``__init__`` for this reason). Flow-aware via the
+    DML2xx dataflow core: ``key = rec["request_id"]; fam.labels(k=key)``
+    still fires. Bounded label values (statuses, replica names, tenant
+    tiers) and constant family names never match; functions *defined*
+    inside the loop run at call time and are skipped."""
+
+    def label_values(call: ast.Call):
+        yield from call.args
+        for kw in call.keywords:
+            if kw.arg is not None:
+                yield kw.value
+
+    def hit(call: ast.Call) -> str | None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "labels" and (call.args or call.keywords):
+            scopes = ctx.scopes_at(call)
+            if any(_request_idish(v, scopes) for v in label_values(call)):
+                return (
+                    "per-request label value in a metrics .labels(...) call "
+                    "inside a serve loop: every request mints a NEW series, so "
+                    "the registry grows with traffic forever (cardinality is "
+                    "memory); resolve the series handle once outside the loop "
+                    "and label by a bounded vocabulary (status/replica/tenant "
+                    "tier), as the registry's max_series collapse is a "
+                    "backstop, not a design"
+                )
+            return None
+        if func.attr in _METRIC_CREATE_ATTRS:
+            recv = attr_chain(func.value)
+            if not (recv and _REGISTRY_RECV.search(recv[-1])):
+                return None
+            name_arg = call.args[0] if call.args else next(
+                (kw.value for kw in call.keywords if kw.arg == "name"), None
+            )
+            if name_arg is None or isinstance(name_arg, ast.Constant):
+                return None  # a constant family name is registered once
+            if _request_idish(name_arg, ctx.scopes_at(call)):
+                return (
+                    "metric family created inside a serve loop with a "
+                    "per-request NAME: one family per request id is unbounded "
+                    "registry growth (and every family re-renders on each "
+                    "scrape); create ONE family with a constant name before "
+                    "the loop and put the bounded dimension in a label"
+                )
+        return None
+
+    def visit(node: ast.AST, in_loop: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # the nested body executes when called, not per iteration
+                yield from visit(child, False)
+                continue
+            if in_loop and isinstance(child, ast.Call):
+                message = hit(child)
+                if message is not None:
+                    fn = ctx.enclosing_function(child)
+                    yield _f(ctx, "DML215", child, message, getattr(fn, "name", ""))
+            yield from visit(
+                child, in_loop or isinstance(child, (ast.For, ast.AsyncFor, ast.While))
+            )
+
+    yield from visit(ctx.tree, False)

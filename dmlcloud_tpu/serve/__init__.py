@@ -50,6 +50,18 @@ static batch per call; this package turns it into a serving engine:
   the engine-side guard), per-tenant deficit-round-robin placement with
   stable prefix-affinity hints (``prefix_keys``), per-replica circuit
   breakers, and router-coordinated graceful drain of one replica.
+- **Observability plane** (doc/observability.md): request-scoped tracing
+  — the router mints one trace id per request and every span it touches
+  (``route``/``queue_wait``/``admission``/``prefix_lookup``/``prefill``/
+  ``cow_fork``/decode batches/``failover``) links into a single causal
+  trace across replicas and retries; a typed metrics registry
+  (``ServeEngine(metrics=True)``, ``engine.metrics_text()`` /
+  ``Router.metrics_text()``, optional :class:`MetricsServer` HTTP
+  endpoint, ``python -m dmlcloud_tpu top``); and declarative
+  :class:`SLO` objectives with multi-window burn-rate alerting
+  (:class:`SLOMonitor`, ``slos=`` — alerts journal as ``slo_alert``
+  spans and surface in the ledger summary, ``diag --run`` and the drain
+  verdict).
 
 Quick start::
 
@@ -69,9 +81,11 @@ from .chaos import ChaosError, ChaosMonkey
 from .engine import DuplicateRequest, ServeEngine
 from .kv_pool import KVBlockPool, PoolExhausted
 from .ledger import ServeLedger
+from .metrics_http import MetricsServer
 from .prefix_cache import PrefixCache, PrefixMatch, prefix_keys
 from .router import Router
 from .scheduler import Request, Scheduler, TERMINAL_STATUSES
+from .slo import SLO, SLOMonitor
 
 __all__ = [
     "AdapterSet",
@@ -79,11 +93,14 @@ __all__ = [
     "ChaosMonkey",
     "DuplicateRequest",
     "KVBlockPool",
+    "MetricsServer",
     "PoolExhausted",
     "PrefixCache",
     "PrefixMatch",
     "Request",
     "Router",
+    "SLO",
+    "SLOMonitor",
     "Scheduler",
     "ServeEngine",
     "ServeLedger",

@@ -379,6 +379,16 @@ class ServeEngine:
       write into a shared page; the pool evicts leaf-first by LRU when
       the free list runs dry. See serve/prefix_cache.py + doc/serving.md.
     - ``guard``: ``TraceGuard`` action on a signature leak ("raise"/"warn").
+    - ``metrics``: arm the typed metrics registry (True for a fresh
+      :class:`~dmlcloud_tpu.telemetry.metrics_registry.MetricsRegistry`,
+      or pass one to share). Series handles resolve at construction
+      (DML215); :meth:`metrics_text` exposes Prometheus text. Off (None)
+      by default — the uninstrumented hot loop is untouched.
+    - ``slos``: declarative objectives
+      (:class:`~dmlcloud_tpu.serve.slo.SLO` list) evaluated every step
+      over the injectable clock; burn-rate alerts journal as
+      ``slo_alert`` spans and surface in the ledger summary + drain
+      verdict (doc/observability.md).
     """
 
     def __init__(
@@ -418,6 +428,8 @@ class ServeEngine:
         watchdog=None,
         max_done: int | None = None,
         ledger_max_records: int | None = None,
+        metrics: Any = None,
+        slos: Any = None,
     ):
         from ..models.quant import prepare_decode_params
 
@@ -526,6 +538,68 @@ class ServeEngine:
         self._drain_requeue = False
         self._drain_started: float | None = None
 
+        # -- observability plane (doc/observability.md) -------------------
+        # metrics: every series handle is resolved ONCE here — the hot
+        # loop only ever touches pre-bound children (one float add per
+        # event; a per-request labels() call is lint rule DML215)
+        self.metrics = None
+        if metrics:
+            from ..telemetry.metrics_registry import (
+                ITL_BUCKETS, QUEUE_DEPTH_BUCKETS, TTFT_BUCKETS, MetricsRegistry,
+            )
+            from .scheduler import TERMINAL_STATUSES
+
+            reg = metrics if isinstance(metrics, MetricsRegistry) else MetricsRegistry()
+            self.metrics = reg
+            self._m_requests = reg.counter(
+                "dml_serve_requests_total", "requests submitted")
+            self._m_tokens = reg.counter(
+                "dml_serve_tokens_total", "tokens emitted (all requests)")
+            terminal = reg.counter(
+                "dml_serve_terminal_total", "terminal statuses",
+                labels=("status",), max_series=len(TERMINAL_STATUSES) + 1)
+            self._m_terminal = {s: terminal.labels(status=s) for s in TERMINAL_STATUSES}
+            self._m_drafted = reg.counter(
+                "dml_serve_drafted_tokens_total", "speculative tokens proposed")
+            self._m_accepted = reg.counter(
+                "dml_serve_accepted_tokens_total", "speculative tokens accepted")
+            self._m_ttft = reg.histogram(
+                "dml_serve_ttft_seconds", "time to first token",
+                buckets=TTFT_BUCKETS)
+            self._m_itl = reg.histogram(
+                "dml_serve_itl_seconds", "inter-token latency",
+                buckets=ITL_BUCKETS)
+            self._m_depth = reg.histogram(
+                "dml_serve_queue_depth", "admission queue depth per step",
+                buckets=QUEUE_DEPTH_BUCKETS)
+            self._m_batch = reg.gauge(
+                "dml_serve_decode_batch_size", "rows in the last decode batch")
+            self._m_active = reg.gauge(
+                "dml_serve_active_requests", "admitted, unfinished requests")
+            self._m_free = reg.gauge(
+                "dml_serve_kv_blocks_free", "free blocks in the target pool")
+            self._m_live = reg.gauge(
+                "dml_serve_kv_blocks_live", "live blocks in the target pool")
+            self._m_shared = reg.gauge(
+                "dml_serve_kv_blocks_shared", "refcount>1 blocks (prefix sharing)")
+            self._m_pref_lookups = reg.counter(
+                "dml_serve_prefix_lookups_total", "prefix-cache lookups at admission")
+            self._m_pref_hits = reg.counter(
+                "dml_serve_prefix_hits_total", "admissions with a cached prefix")
+            self._m_pref_saved = reg.counter(
+                "dml_serve_prefill_tokens_saved_total",
+                "prefill tokens skipped via the prefix cache")
+        # SLOs: declarative objectives over the SAME injectable clock
+        self.slo = None
+        if slos:
+            from .slo import SLOMonitor
+
+            self.slo = slos if isinstance(slos, SLOMonitor) else SLOMonitor(
+                slos, clock=clock
+            )
+            # the summary's "slo" section reads the live monitor
+            self.ledger.slo_monitor = self.slo
+
         self.batch_buckets = (
             resolve_buckets(batch_buckets) if batch_buckets else _pow2_buckets(max_slots)
         )
@@ -609,6 +683,7 @@ class ServeEngine:
         priority: int = 0,
         tenant: str | None = None,
         token: str | None = None,
+        trace: str | None = None,
     ) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D int32
         token sequence (no padding — paged rows sit at their own absolute
@@ -632,7 +707,13 @@ class ServeEngine:
         admitting a second copy — the at-most-once guard a router retry
         leans on after an AMBIGUOUS failure (did the dead replica's
         submit land before it died?). Tokens age out with the terminal-
-        record retention (``max_done``)."""
+        record retention (``max_done``).
+
+        ``trace`` is the request-scoped trace id every span this request
+        produces links under (doc/observability.md). A router mints one
+        at ``Router.submit`` and threads it through failover, so the
+        whole cross-replica history is ONE causal trace; a standalone
+        engine mints ``tr-<rid>`` when none is given."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -662,6 +743,10 @@ class ServeEngine:
         self._next_id += 1
         if rid in self._all:  # a reused rid would silently clobber bookkeeping
             raise RuntimeError(f"request id {rid} already exists (corrupt id counter)")
+        if trace is None:
+            trace = f"tr-{rid}"
+        if self.metrics is not None:
+            self._m_requests.inc()
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens), adapter=adapter,
             temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
@@ -671,7 +756,7 @@ class ServeEngine:
         seq = _Sequence(
             req=req, arrival=now, adapter_id=aid,
             deadline=None if deadline_s is None else now + float(deadline_s),
-            tenant=resolved_tenant, priority=int(priority), token=token,
+            tenant=resolved_tenant, priority=int(priority), token=token, trace=trace,
             temperature=self._temperature if temperature is None else float(temperature),
             top_k=self._top_k if top_k is None else int(top_k),
             top_p=self._top_p if top_p is None else float(top_p),
@@ -744,9 +829,18 @@ class ServeEngine:
     def _record_terminal(self, seq, now: float, error: str | None = None) -> None:
         rid = seq.req.id
         self.ledger.finished(rid, now, status=seq.status)
+        if self.metrics is not None:
+            child = self._m_terminal.get(seq.status)
+            if child is not None:
+                child.inc()
+        if self.slo is not None:
+            self.slo.record_terminal(seq.tenant, seq.status, now)
         if seq.status == "error":
+            # the per-request fault span stamps the trace with its
+            # terminal status — a chaos-injected failure is readable
+            # straight off the request track
             journal.emit("fault", now, label=f"req{rid}", request=rid,
-                         error=error or "")
+                         trace=seq.trace, status=seq.status, error=error or "")
         if seq.status == "ok":
             self._done[rid] = seq
         self._terminal.append(rid)
@@ -815,17 +909,38 @@ class ServeEngine:
             self._drain_step(now)
         else:
             for seq in self.scheduler.admit(now):
-                self.ledger.admitted(seq.req.id, now)
+                rid = seq.req.id
+                self.ledger.admitted(rid, now)
                 if self.prefix is not None:
                     # prefill-skip accounting: saved = the divergence point the
                     # scheduler rolled prefill forward to (cached tokens, minus
                     # the one re-fed token of an exact full-block match)
                     self.ledger.prefix_match(
-                        seq.req.id, cached=seq.cached_tokens, saved=seq.fill,
+                        rid, cached=seq.cached_tokens, saved=seq.fill,
                         prompt=seq.prompt_len,
                     )
-                journal.emit("queue_wait", seq.arrival, now, label=f"req{seq.req.id}",
-                             request=seq.req.id, depth=self.scheduler.depth())
+                    journal.emit("prefix_lookup", now, now, label=f"req{rid}",
+                                 request=rid, trace=seq.trace,
+                                 cached=seq.cached_tokens, saved=seq.fill,
+                                 shared=seq.shared)
+                    if self.metrics is not None:
+                        self._m_pref_lookups.inc()
+                        if seq.cached_tokens > 0:
+                            self._m_pref_hits.inc()
+                        self._m_pref_saved.inc(seq.fill)
+                journal.emit("queue_wait", seq.arrival, now, label=f"req{rid}",
+                             request=rid, trace=seq.trace,
+                             depth=self.scheduler.depth())
+                journal.emit("admission", now, now, label=f"req{rid}",
+                             request=rid, trace=seq.trace, tenant=seq.tenant,
+                             blocks=len(seq.blocks), cached=seq.cached_tokens)
+        if self.metrics is not None:
+            self._m_depth.observe(self.scheduler.depth())
+            self._m_active.set(self.scheduler.active)
+            self._m_free.set(self.pool.num_free)
+            self._m_live.set(self.pool.num_live)
+        if self.slo is not None:
+            self.slo.evaluate(now)
         did = False
         seq = self.scheduler.next_prefill()
         if seq is not None:
@@ -929,6 +1044,7 @@ class ServeEngine:
                 "drain_s": round(now - self._drain_started, 6),
                 "statuses": counts,
                 "drained_clean": self.idle,
+                "slo_alerts": len(self.slo.alerts) if self.slo is not None else 0,
             },
         }
         journal.emit("drain", self._drain_started, now, label=self._drain_kind,
@@ -954,6 +1070,32 @@ class ServeEngine:
         if self.prefix is not None:
             leaked += len(self.prefix.leaked_locks())
         return leaked
+
+    def metrics_text(self) -> str:
+        """The engine's metrics registry rendered as Prometheus text
+        (empty string when constructed without ``metrics=``). Pool
+        occupancy gauges are refreshed at scrape time so an idle engine
+        still reports truthful numbers; wire this to
+        :class:`~dmlcloud_tpu.serve.metrics_http.MetricsServer` (or any
+        scraper) — a scrape never touches device state."""
+        snap = self.metrics_snapshot()
+        if snap is None:
+            return ""
+        from ..telemetry.metrics_registry import to_prometheus_text
+
+        return to_prometheus_text(snap)
+
+    def metrics_snapshot(self) -> dict | None:
+        """Gauge-refreshed registry snapshot (plain dicts; None when
+        metrics are off) — what :meth:`metrics_text` renders and what the
+        router merges across replicas under a ``replica`` label."""
+        if self.metrics is None:
+            return None
+        self._m_free.set(self.pool.num_free)
+        self._m_live.set(self.pool.num_live)
+        self._m_shared.set(self.pool.stats()["shared"])
+        self._m_active.set(self.scheduler.active)
+        return self.metrics.snapshot()
 
     def serve_trace(self, trace, clock=None, sleep=time.sleep) -> dict:
         """Replay a timed request trace in real time: ``trace`` is a list
@@ -1056,8 +1198,8 @@ class ServeEngine:
             seq.blocks[bi] = new
             self.pool.release([old])
             seq.shared = min(seq.shared, bi)
-            journal.emit("prefill", journal.now(), label=f"req{seq.req.id}:cow",
-                         request=seq.req.id, cow_block=bi)
+            journal.emit("cow_fork", journal.now(), label=f"req{seq.req.id}:cow",
+                         request=seq.req.id, trace=seq.trace, cow_block=bi)
 
     def _table_rows(self, seqs, nb: int, draft: bool = False) -> np.ndarray:
         pool = self.draft_pool if draft else self.pool
@@ -1091,7 +1233,7 @@ class ServeEngine:
             [seq.adapter_id], row_params,
         )
         journal.emit("prefill", t0, label=f"req{seq.req.id}", request=seq.req.id,
-                     chunk=n, fill=seq.fill + n, blocks=nb)
+                     trace=seq.trace, chunk=n, fill=seq.fill + n, blocks=nb)
         if self.spec_k:
             # the draft pool needs the same prompt K/V: one mirrored chunk
             # through the draft model (its sampled token is discarded)
@@ -1103,13 +1245,17 @@ class ServeEngine:
                 use_adapters=False,  # the draft proposes base-model (spec x LoRA)
             )
             journal.emit("draft", t1, label=f"req{seq.req.id}:prefill",
-                         request=seq.req.id, chunk=n, blocks=nb)
+                         request=seq.req.id, traces=[seq.trace], chunk=n, blocks=nb)
         seq.fill += n
         if final:
             # the last real prompt position's logits ARE the first token —
             # time-to-first-token ends here, before any decode step
             now = self.clock()
             self.ledger.first_token(seq.req.id, now)
+            if self.metrics is not None:
+                self._m_ttft.observe(now - seq.arrival)
+            if self.slo is not None:
+                self.slo.record_ttft(seq.tenant, now - seq.arrival, now)
             self.scheduler.prefill_done(seq)
             seq.prev_token = int(seq.req.prompt[-1])
             if self.prefix is not None:
@@ -1145,8 +1291,10 @@ class ServeEngine:
         )
         now = self.clock()
         journal.emit("decode_batch", t0, label=f"b{bb}", active=len(batch),
-                     bucket=bb, blocks=nb)
+                     bucket=bb, blocks=nb, traces=[s.trace for s in batch])
         self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        if self.metrics is not None:
+            self._m_batch.set(len(batch))
         for i, s in enumerate(batch):
             s.fill += 1  # the fed token's K/V landed at its position
             self._emit(s, int(tok[i]), now)
@@ -1210,7 +1358,7 @@ class ServeEngine:
             return
         self.draft_pool.swap(dpools)
         journal.emit("draft", t0, label=f"b{bb}", active=len(batch),
-                     bucket=bb, blocks=nb, k=k)
+                     bucket=bb, blocks=nb, k=k, traces=[s.trace for s in batch])
         t1 = journal.now()
         self._chaos("verify", batch)
         packed, tpools = self._verify_fn(
@@ -1223,11 +1371,16 @@ class ServeEngine:
         out = np.asarray(packed)
         now = time.perf_counter()
         journal.emit("verify", t1, label=f"b{bb}", active=len(batch),
-                     bucket=bb, blocks=nb, k=k)
+                     bucket=bb, blocks=nb, k=k, traces=[s.trace for s in batch])
         self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        if self.metrics is not None:
+            self._m_batch.set(len(batch))
         for i, s in enumerate(batch):
             n_new = int(out[i, k + 1])
             self.ledger.spec_round(s.req.id, drafted=k, accepted=int(out[i, k + 2]))
+            if self.metrics is not None:
+                self._m_drafted.inc(k)
+                self._m_accepted.inc(int(out[i, k + 2]))
             for tok in out[i, :n_new]:
                 prev_last = s.last_token
                 s.fill += 1  # this token's K/V was written by the round
@@ -1307,14 +1460,19 @@ class ServeEngine:
         out = np.asarray(packed)
         now = time.perf_counter()
         journal.emit("medusa", t0, label=f"b{bb}", active=len(batch),
-                     bucket=bb, blocks=nb, k=k)
+                     bucket=bb, blocks=nb, k=k, traces=[s.trace for s in batch])
         self.ledger.step_sample(self.scheduler.depth(), len(batch))
+        if self.metrics is not None:
+            self._m_batch.set(len(batch))
         for i, s in enumerate(batch):
             n_new = int(out[i, k])
             if k > 1:
                 self.ledger.spec_round(
                     s.req.id, drafted=k - 1, accepted=int(out[i, k + 1])
                 )
+                if self.metrics is not None:
+                    self._m_drafted.inc(k - 1)
+                    self._m_accepted.inc(int(out[i, k + 1]))
                 s.medusa_pending = out[i, k + 2 : 2 * k + 1].copy()
             for tok in out[i, :n_new]:
                 prev_last = s.last_token
@@ -1338,12 +1496,19 @@ class ServeEngine:
         A failure inside the fallback decode propagates to ``step``'s
         handler, which fails the batch."""
         journal.emit("fault", t0, label=f"b{bb}:{label}", active=bb,
-                     error=f"{type(exc).__name__}: {exc}")
+                     error=f"{type(exc).__name__}: {exc}",
+                     traces=[s.trace for s in batch])
         self._decode(batch)
 
     def _emit(self, seq, tok: int, now: float) -> None:
         seq.out.append(tok)
         self.ledger.token(seq.req.id)
+        if self.metrics is not None:
+            self._m_tokens.inc()
+            t_prev = getattr(seq, "_last_tok_t", None)
+            if t_prev is not None:
+                self._m_itl.observe(now - t_prev)
+            seq._last_tok_t = now
         if tok == seq.eos_id or len(seq.out) >= seq.req.max_new_tokens:
             if self.prefix is not None and seq.fill > seq.prompt_len:
                 # multi-turn sharing: publish the full blocks the decode

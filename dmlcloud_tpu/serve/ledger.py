@@ -267,7 +267,13 @@ class ServeLedger:
         )
         ttft = list(self._ttfts)
         statuses = self.status_counts()
+        # the live SLO scorecard when an engine attached its monitor
+        # (ServeEngine(slos=...) sets ledger.slo_monitor): declared
+        # objectives judged over their sliding windows, alert count
+        slo = getattr(self, "slo_monitor", None)
+        slo_section = {} if slo is None else {"slo": slo.status()}
         return {
+            **slo_section,
             "requests": agg["requests"] + len(self.records),
             "completed": agg["completed"] + len(done),
             "statuses": statuses,

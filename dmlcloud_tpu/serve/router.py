@@ -112,9 +112,9 @@ class _Record:
     """The router's request of record — survives its replica."""
 
     __slots__ = (
-        "rid", "prompt", "max_new", "kwargs", "tenant", "token", "status",
-        "replica", "engine_rid", "retries", "not_before", "affinity",
-        "arrival",
+        "rid", "prompt", "max_new", "kwargs", "tenant", "token", "trace",
+        "status", "replica", "engine_rid", "retries", "not_before",
+        "affinity", "arrival",
     )
 
     def __init__(self, rid, prompt, max_new, kwargs, tenant, token, affinity, now):
@@ -124,6 +124,10 @@ class _Record:
         self.kwargs = kwargs  # submit passthrough (deadline_s, priority, ...)
         self.tenant = tenant
         self.token = token
+        # one trace id for the request's WHOLE life: the token rotates on
+        # failover (.fN suffixes) but the trace never does, so every
+        # placement attempt links into a single causal trace
+        self.trace = f"tr-{rid}"
         self.status: str | None = None  # router-terminal, else None
         self.replica: str | None = None  # current assignment
         self.engine_rid: int | None = None
@@ -429,14 +433,24 @@ class Router:
                 rec.status = "error"
                 journal.emit(
                     "failover", now, label=f"req{rec.rid}", request=rec.rid,
-                    replica=rep.name, outcome="retries_exhausted",
+                    trace=rec.trace, replica=rep.name,
+                    outcome="retries_exhausted",
+                )
+                # the router-side terminal: stamp the trace the same way
+                # the engine's fault path does, so linked_trace_report
+                # surfaces the status even when no engine ever erred
+                journal.emit(
+                    "fault", now, label=f"req{rec.rid}", request=rec.rid,
+                    trace=rec.trace, status="error",
+                    reason="retries_exhausted",
                 )
                 continue
             rec.not_before = now + self.backoff_base_s * (2.0 ** (rec.retries - 1))
             self.failovers += 1
             journal.emit(
                 "failover", now, label=f"req{rec.rid}", request=rec.rid,
-                replica=rep.name, retry=rec.retries, reason=reason,
+                trace=rec.trace, replica=rep.name, retry=rec.retries,
+                reason=reason,
             )
             retry.append(rec)
         self._requeue_front(retry)
@@ -490,7 +504,8 @@ class Router:
     def _place(self, rec: _Record, rep: _Replica, now: float) -> None:
         try:
             rec.engine_rid = rep.engine.submit(
-                rec.prompt, rec.max_new, token=rec.token, **rec.kwargs
+                rec.prompt, rec.max_new, token=rec.token, trace=rec.trace,
+                **rec.kwargs
             )
         except DuplicateRequest as dup:
             # the ambiguous-failure window: the "failed" submit actually
@@ -503,7 +518,8 @@ class Router:
             rep.probe_rid = rec.rid
         journal.emit(
             "route", now, label=f"req{rec.rid}", request=rec.rid,
-            replica=rep.name, tenant=rec.tenant, retry=rec.retries,
+            trace=rec.trace, replica=rep.name, tenant=rec.tenant,
+            retry=rec.retries,
         )
 
     def _place_pending(self, now: float) -> None:
@@ -718,3 +734,37 @@ class Router:
                 for name, rep in self.replicas.items()
             },
         }
+
+    def metrics_text(self) -> str:
+        """One Prometheus page for the whole pool: every replica's
+        registry snapshot (gauges refreshed) merged under a ``replica``
+        label, plus the router's own failure-handling series
+        (``dml_router_failovers_total`` / ``dml_router_kills_total`` /
+        ``dml_router_pending_requests`` and a per-replica
+        ``dml_router_breaker_state`` gauge: 0=closed, 1=half_open,
+        2=open). Families keep ONE ``# HELP``/``# TYPE`` header across
+        replicas — the page parses as a single valid exposition. Replicas
+        constructed without ``metrics=`` simply contribute nothing."""
+        from ..telemetry.metrics_registry import MetricsRegistry, to_prometheus_text
+
+        reg = MetricsRegistry()
+        reg.counter("dml_router_failovers_total",
+                    "failure-driven resubmissions").inc(self.failovers)
+        reg.counter("dml_router_kills_total",
+                    "replicas declared dead").inc(self.kills)
+        reg.gauge("dml_router_pending_requests",
+                  "records awaiting placement").set(
+            sum(len(q) for q in self._queues.values()))
+        breaker = reg.gauge(
+            "dml_router_breaker_state",
+            "per-replica circuit breaker (0=closed, 1=half_open, 2=open)",
+            labels=("replica",), max_series=len(self.replicas) + 1)
+        state_code = {"closed": 0, "half_open": 1, "open": 2}
+        for name, rep in self.replicas.items():
+            breaker.labels(replica=name).set(state_code[rep.breaker])
+        pages: list = [reg.snapshot()]
+        for name, rep in self.replicas.items():
+            snap = rep.engine.metrics_snapshot()
+            if snap is not None:
+                pages.append((snap, {"replica": name}))
+        return to_prometheus_text(*pages)

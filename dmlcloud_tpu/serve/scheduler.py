@@ -157,6 +157,10 @@ class _Sequence:
     # caller-supplied idempotency token (engine dedups on it — a router
     # retry after an ambiguous failure can never double-admit)
     token: str | None = None
+    # trace id stamped on every span this request touches; the router
+    # mints one per logical request and REUSES it across failover retries
+    # so the whole causal chain links into a single trace
+    trace: str | None = None
     # prefix-cache state: leading table entries mapped READ-ONLY from the
     # radix tree (refcount > 1 is the ground truth; this count is the
     # observable), matched tokens, and spare blocks reserved for COW forks

@@ -14,6 +14,12 @@ Three parts, armed together via ``TrainingPipeline(telemetry=True|dir)``:
   epoch and per run, reduced across hosts on the packed metric collective
   (``misc/goodput``, ``misc/mfu``), plus a root-only end-of-run table — the
   PaLM-style (arXiv 2204.02311) headline efficiency number.
+- **Metrics registry** (``metrics_registry.py``): typed counters / gauges /
+  fixed-bucket histograms with bounded label cardinality and Prometheus
+  text exposition — the serve observability plane's "what is happening
+  right now" surface (``ServeEngine(metrics=True)``, ``Router
+  .metrics_text()``, ``serve/metrics_http.py``, ``python -m dmlcloud_tpu
+  top``).
 - **Hang watchdog + flight recorder** (``watchdog.py``): a per-host heartbeat
   that, when span/step progress stops (or on an uncaught exception), dumps
   all-thread stacks, the last-N spans, and the barrier arrival state to
@@ -24,31 +30,46 @@ Everything here is stdlib-only at import time (no jax), so the journal can
 be read and converted on any machine.
 """
 
-from . import goodput, journal, watchdog
+from . import goodput, journal, metrics_registry, watchdog
 from .goodput import GoodputLedger, ledger_from_tracker
 from .journal import (
+    REQUEST_SPAN_KINDS,
     SCHEMA_VERSION,
     SPAN_KINDS,
     SpanJournal,
     active_journal,
+    linked_trace_report,
     load_journals,
     span,
     to_chrome_trace,
+    to_request_trace,
+)
+from .metrics_registry import (
+    MetricsRegistry,
+    parse_prometheus_text,
+    to_prometheus_text,
 )
 from .watchdog import HangWatchdog
 
 __all__ = [
     "goodput",
     "journal",
+    "metrics_registry",
     "watchdog",
     "GoodputLedger",
     "ledger_from_tracker",
+    "REQUEST_SPAN_KINDS",
     "SCHEMA_VERSION",
     "SPAN_KINDS",
     "SpanJournal",
     "active_journal",
+    "linked_trace_report",
     "load_journals",
     "span",
     "to_chrome_trace",
+    "to_request_trace",
+    "MetricsRegistry",
+    "parse_prometheus_text",
+    "to_prometheus_text",
     "HangWatchdog",
 ]

@@ -27,6 +27,7 @@ silent field change.
 
 from __future__ import annotations
 
+import atexit
 import collections
 import io
 import json
@@ -39,6 +40,8 @@ from typing import Any, Iterable
 __all__ = [
     "SCHEMA_VERSION",
     "SPAN_KINDS",
+    "REQUEST_SPAN_KINDS",
+    "BATCH_SPAN_KINDS",
     "SpanJournal",
     "activate",
     "deactivate",
@@ -48,6 +51,8 @@ __all__ = [
     "now",
     "load_journals",
     "to_chrome_trace",
+    "to_request_trace",
+    "linked_trace_report",
 ]
 
 SCHEMA_VERSION = 1
@@ -80,8 +85,28 @@ SPAN_KINDS = frozenset(
         "route",  # serving: router placement of one request on a replica
         "failover",  # serving: resubmission of a request off a dead replica
         "replica_drain",  # serving: router-coordinated drain of one replica
+        "medusa",  # serving: one fused Medusa propose+verify round
+        "admission",  # serving: scheduler admission of one request
+        "prefix_lookup",  # serving: radix-tree prefix match at admission
+        "cow_fork",  # serving: one copy-on-write block fork
+        "slo_alert",  # serving: a multi-window SLO burn-rate alert fired
     }
 )
+
+#: Serve span kinds that are REQUEST-SCOPED: once request tracing is on
+#: (``Router.submit``/``ServeEngine.submit`` mint trace ids), every
+#: record of these kinds carries a ``trace`` attr — a record without one
+#: is an ORPHAN (:func:`linked_trace_report` flags it). A ``fault``
+#: record is request-scoped exactly when it carries a ``request`` attr
+#: (batch-level degrade faults are not tied to one request).
+REQUEST_SPAN_KINDS = frozenset(
+    {"queue_wait", "admission", "prefix_lookup", "prefill", "cow_fork",
+     "route", "failover"}
+)
+
+#: Serve span kinds that advance a whole decode BATCH: they carry a
+#: ``traces`` list attr linking every request that rode the batch.
+BATCH_SPAN_KINDS = frozenset({"decode_batch", "draft", "verify", "medusa"})
 
 _JOURNAL_GLOB_PREFIX = "journal-rank"
 
@@ -104,6 +129,7 @@ class SpanJournal:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._atexit = None
         self._flush_interval = float(flush_interval)
         self._wall0 = time.time()
         self._perf0 = time.perf_counter()
@@ -188,13 +214,19 @@ class SpanJournal:
         return len(batch)
 
     def start(self) -> "SpanJournal":
-        """Start the off-thread flusher (idempotent)."""
+        """Start the off-thread flusher (idempotent), and register an
+        ``atexit`` flush — spans emitted after the flusher's last wakeup
+        survive a process that exits without calling :meth:`close` (the
+        daemon thread dies mid-interval; the hook drains what it left)."""
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._flush_loop, name=f"dml-journal-r{self.rank}", daemon=True
             )
             self._thread.start()
+        if self._atexit is None:
+            self._atexit = self.flush
+            atexit.register(self._atexit)
         return self
 
     def _flush_loop(self) -> None:
@@ -205,11 +237,15 @@ class SpanJournal:
                 pass
 
     def close(self) -> None:
-        """Stop the flusher and write everything still pending."""
+        """Stop the flusher, drop the atexit hook and write everything
+        still pending."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._atexit is not None:
+            atexit.unregister(self._atexit)
+            self._atexit = None
         try:
             self.flush()
         except OSError:
@@ -355,3 +391,105 @@ def to_chrome_trace(records: Iterable[dict]) -> dict:
         "displayTimeUnit": "ms",
         "metadata": {"source": "dmlcloud_tpu telemetry journal", "schema": SCHEMA_VERSION},
     }
+
+
+def _record_traces(rec: dict) -> list:
+    """The trace id(s) a record links into: its ``trace`` attr, or the
+    ``traces`` list a batch span carries (one span, many requests)."""
+    t = rec.get("trace")
+    if t is not None:
+        return [t]
+    ts = rec.get("traces")
+    return list(ts) if ts else []
+
+
+def to_request_trace(records: Iterable[dict]) -> dict:
+    """The REQUEST-TRACK view of a merged journal: Chrome-trace JSON with
+    one track (thread) per trace id under a single "requests" process,
+    so Perfetto shows each request's causal chain — route, queue wait,
+    admission, prefill chunks, every decode batch it rode, failover hops
+    — as one horizontal lane even when the spans came from different
+    replicas/ranks. Batch spans are duplicated into every linked
+    request's track (the batch IS part of each rider's critical path).
+    Records without trace linkage are skipped — this view is additive to
+    :func:`to_chrome_trace`, never a replacement."""
+    records = [r for r in records if "ts" in r and "dur" in r]
+    t0 = min((r["ts"] for r in records), default=0.0)
+    # track order: first appearance of each trace id
+    tids: dict[str, int] = {}
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0, "args": {"name": "requests"}}
+    ]
+    for r in records:
+        for trace in _record_traces(r):
+            trace = str(trace)
+            if trace not in tids:
+                tids[trace] = len(tids)
+                events.append(
+                    {"name": "thread_name", "ph": "M", "pid": 0,
+                     "tid": tids[trace], "args": {"name": trace}}
+                )
+            kind = str(r.get("kind", "?"))
+            label = r.get("label")
+            args = {
+                k: v for k, v in r.items()
+                if k not in ("v", "kind", "label", "ts", "dur", "tid", "traces")
+            }
+            events.append(
+                {
+                    "name": f"{kind}:{label}" if label else kind,
+                    "cat": kind,
+                    "ph": "X",
+                    "ts": round((r["ts"] - t0) * 1e6, 3),
+                    "dur": round(r["dur"] * 1e6, 3),
+                    "pid": 0,
+                    "tid": tids[trace],
+                    "args": args,
+                }
+            )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "source": "dmlcloud_tpu telemetry journal (request tracks)",
+            "schema": SCHEMA_VERSION,
+            "traces": len(tids),
+        },
+    }
+
+
+def linked_trace_report(records: Iterable[dict]) -> dict:
+    """Walk a merged journal and group serve spans by trace id — the
+    linkage auditor the router chaos drill gates on (zero orphans).
+    Returns plain dicts::
+
+        {"traces": {trace_id: [records, ts-sorted]},
+         "orphans": [request-scoped serve records with NO trace linkage],
+         "statuses": {trace_id: terminal status stamped by a fault span
+                      or None}}
+
+    A record is an orphan when its kind is in :data:`REQUEST_SPAN_KINDS`
+    (or it is a ``fault`` carrying a ``request`` attr — a per-request
+    fault, not a batch degrade) but it carries neither ``trace`` nor
+    ``traces`` — exactly the span that would dangle unexplained in the
+    request-track view."""
+    traces: dict[str, list[dict]] = {}
+    orphans: list[dict] = []
+    statuses: dict[str, Any] = {}
+    for r in records:
+        linked = _record_traces(r)
+        kind = r.get("kind")
+        if not linked:
+            if kind in REQUEST_SPAN_KINDS or (kind == "fault" and "request" in r):
+                orphans.append(r)
+            continue
+        for t in linked:
+            t = str(t)
+            traces.setdefault(t, []).append(r)
+            if kind == "fault":
+                statuses[t] = r.get("status", "error")
+    for spans in traces.values():
+        spans.sort(key=lambda r: r.get("ts", 0.0))
+    for t in traces:
+        statuses.setdefault(t, None)
+    return {"traces": traces, "orphans": orphans, "statuses": statuses}

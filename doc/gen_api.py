@@ -55,6 +55,9 @@ MODULES = [
     ("dmlcloud_tpu.serve.ledger", "Per-request latency ledger (TTFT, queue depth)."),
     ("dmlcloud_tpu.serve.chaos", "Seeded, replayable fault injection for serving drills."),
     ("dmlcloud_tpu.serve.router", "Multi-replica front door: health-checked routing, failover, drain."),
+    ("dmlcloud_tpu.serve.slo", "Declarative SLOs with multi-window burn-rate alerting."),
+    ("dmlcloud_tpu.serve.metrics_http", "Stdlib HTTP endpoint for Prometheus scrapes."),
+    ("dmlcloud_tpu.telemetry.metrics_registry", "Typed metrics: counters, gauges, histograms, Prometheus text."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
     ("dmlcloud_tpu.data.store", "Disk-native data plane: mmap'd .dmlshard corpora + async ShardReader."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),

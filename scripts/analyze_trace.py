@@ -1,4 +1,5 @@
-"""Roofline breakdown of a ``jax.profiler`` trace, by HLO category.
+"""Roofline breakdown of a ``jax.profiler`` trace, by HLO category —
+or, pointed at a SERVE run's span journals, the per-request latency table.
 
 Thin CLI over ``dmlcloud_tpu.utils.profiling.roofline`` (which parses the
 xplane.pb's own per-op counters — the same data XProf's op-profile tab
@@ -16,7 +17,15 @@ Notes on the counters (they are the chip's own accounting, not estimates):
   can exceed the HBM peak; per-op numbers near the HBM peak still identify
   bandwidth-bound ops (their operands stream from HBM).
 
-Requires tensorflow (baked into this image) for the xplane proto only.
+When the directory holds telemetry span journals instead (a serve run:
+``journal-rank*.jsonl`` under it or its ``telemetry/``), the analysis
+switches to the request plane — per-request TTFT/ITL percentiles derived
+from the linked traces (doc/observability.md), with ``--tenant`` focusing
+one tenant's requests. ITL is estimated from the gaps between successive
+decode batches a request rode (the journal records batches, not tokens).
+
+Requires tensorflow (baked into this image) for the xplane proto only —
+the serve path is pure stdlib + numpy.
 """
 
 import argparse
@@ -25,21 +34,139 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from dmlcloud_tpu.utils.profiling import format_roofline, roofline
+from dmlcloud_tpu.utils.profiling import format_roofline, roofline  # noqa: E402
 
-#: bump when the --json object's shape changes (consumers pin on this)
-JSON_SCHEMA_VERSION = 1
+#: bump when the --json object's shape changes (consumers pin on this).
+#: v2 is ADDITIVE over v1: the roofline keys ("steps"/"peaks"/"rows")
+#: are unchanged; serve-journal inputs add a "serve" object instead.
+JSON_SCHEMA_VERSION = 2
+
+_BATCH_KINDS = ("decode_batch", "draft", "verify", "medusa")
+
+
+def _pcts(vals):
+    import numpy as np
+
+    if not vals:
+        return {"n": 0, "p50": None, "p90": None, "p99": None}
+    return {
+        "n": len(vals),
+        "p50": round(float(np.percentile(vals, 50)), 3),
+        "p90": round(float(np.percentile(vals, 90)), 3),
+        "p99": round(float(np.percentile(vals, 99)), 3),
+    }
+
+
+def serve_summary(records, tenant=None):
+    """Per-request latency scorecard from journal records: TTFT per trace
+    (arrival -> end of its last prefill chunk, the step that samples the
+    first token), ITL per trace (gaps between the ENDS of successive
+    batch spans it rode), grouped overall and per tenant. ``tenant``
+    narrows to one tenant's traces (requests with no tenant attr carry
+    ``""``)."""
+    from dmlcloud_tpu.telemetry.journal import linked_trace_report
+
+    report = linked_trace_report(records)
+    ttfts, itls = [], []
+    tenants = {}
+    kept = 0
+    for tid, spans in report["traces"].items():
+        ten = next(
+            (str(s["tenant"]) for s in spans if s.get("tenant") not in (None,)),
+            "",
+        )
+        if tenant is not None and ten != tenant:
+            continue
+        kept += 1
+        t0 = min(s["ts"] for s in spans)
+        prefills = [s for s in spans if s["kind"] == "prefill"]
+        entry = tenants.setdefault(ten, {"ttft": [], "itl": []})
+        if prefills:
+            ttft_ms = (max(s["ts"] + s["dur"] for s in prefills) - t0) * 1e3
+            ttfts.append(ttft_ms)
+            entry["ttft"].append(ttft_ms)
+        ends = sorted(
+            s["ts"] + s["dur"] for s in spans if s["kind"] in _BATCH_KINDS
+        )
+        gaps = [(b - a) * 1e3 for a, b in zip(ends, ends[1:])]
+        itls.extend(gaps)
+        entry["itl"].extend(gaps)
+    statuses = {}
+    for tid, st in report["statuses"].items():
+        key = st if st is not None else "ok"
+        statuses[key] = statuses.get(key, 0) + 1
+    return {
+        "requests": kept,
+        "spans": len(records),
+        "orphan_spans": len(report["orphans"]),
+        "statuses": statuses,
+        "ttft_ms": _pcts(ttfts),
+        "itl_ms": _pcts(itls),
+        "tenants": {
+            t: {"ttft_ms": _pcts(v["ttft"]), "itl_ms": _pcts(v["itl"])}
+            for t, v in sorted(tenants.items())
+        },
+    }
+
+
+def _format_serve(s):
+    def row(name, p):
+        f = lambda v: "      -" if v is None else f"{v:7.1f}"  # noqa: E731
+        return f"  {name:<10} {p['n']:>5} {f(p['p50'])} {f(p['p90'])} {f(p['p99'])}"
+
+    lines = [
+        f"serve journal: {s['requests']} requests, {s['spans']} spans "
+        f"({s['orphan_spans']} orphans), statuses {s['statuses']}",
+        f"  {'':<10} {'n':>5} {'p50':>7} {'p90':>7} {'p99':>7}",
+        row("ttft_ms", s["ttft_ms"]),
+        row("itl_ms", s["itl_ms"]),
+    ]
+    for t, v in s["tenants"].items():
+        lines.append(f"  tenant {t or '(default)'!r}:")
+        lines.append(row("  ttft_ms", v["ttft_ms"]))
+        lines.append(row("  itl_ms", v["itl_ms"]))
+    return "\n".join(lines)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace_dir", help="directory passed to jax.profiler.trace")
+    ap.add_argument(
+        "trace_dir",
+        help="directory passed to jax.profiler.trace, or a serve run dir "
+        "with telemetry journals",
+    )
     ap.add_argument("--steps", type=int, default=30, help="timed steps inside the trace")
     ap.add_argument(
+        "--tenant", default=None,
+        help="serve journals: only this tenant's requests",
+    )
+    ap.add_argument(
         "--json", action="store_true",
-        help='machine-readable output: {"version", "steps", "peaks", "rows"}',
+        help='machine-readable output: {"version", "steps", "peaks", "rows"} '
+        'for a profiler trace, {"version", "serve"} for serve journals',
     )
     args = ap.parse_args(argv)
+
+    # serve-journal mode: span journals under the dir win over xplane
+    from dmlcloud_tpu.telemetry.journal import load_journals
+
+    try:
+        records = load_journals(args.trace_dir)
+    except FileNotFoundError:
+        records = []
+    if records:
+        summary = serve_summary(records, tenant=args.tenant)
+        if args.json:
+            print(json.dumps({"version": JSON_SCHEMA_VERSION, "serve": summary},
+                             sort_keys=True))
+        else:
+            print(_format_serve(summary))
+        return 0
+    if args.tenant is not None:
+        print("analyze_trace: --tenant only applies to serve journals",
+              file=sys.stderr)
+        return 2
+
     peaks, rows = roofline(args.trace_dir, steps=args.steps)
     if not rows:
         # a device plane with zero op events: the traced region dispatched no

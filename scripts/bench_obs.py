@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Observability-plane receipt (doc/observability.md): what does the serve
+observability plane COST, and does it actually link?
+
+- the OVERHEAD arm: two engines replay the pinned CPU-smoke Poisson serve
+  trace (the same one ``bench_serve`` uses) — one bare, one with the full
+  plane armed at once (span journal flushing off-thread, the typed
+  metrics registry's hot-path counters/histograms, SLO monitors evaluated
+  every step). Best-of-N tokens/s per arm against CPU scheduler noise;
+  ``obs_overhead_frac`` is the lower-is-better fraction the committed
+  receipt locks at ≤3% (tests/test_bench_gate.py).
+- the LINKED-TRACE drill: the same kill-one-replica-drain-another router
+  drill as the serve receipt, but with the span journal armed. Every span
+  a request touches — across replicas, failover retries (the idempotency
+  token rotates, the trace id does NOT), and the drained replica's
+  handoff — must link into exactly one per-request trace with ZERO orphan
+  request-scoped spans (``telemetry.journal.linked_trace_report``);
+  ``obs_trace_linked`` is the pass/fail int.
+- exposition validity: ``engine.metrics_text()`` and the router-wide
+  ``Router.metrics_text()`` must parse as valid Prometheus text
+  (``telemetry.metrics_registry.parse_prometheus_text``, the same strict
+  validator the schema-lock test uses); ``obs_metrics_valid`` is the
+  pass/fail int.
+
+Thin CLI over ``bench.bench_obs`` (which runs ``bench.py --obs-child``
+CPU-pinned) so the committed receipt and an interactive investigation run
+the exact same workload. The receipt's flat ``gate`` section merges into
+``bench.py --gate --suite serve`` / scripts/perf_gate.sh alongside every
+committed BENCH_serve_*.json (missing metric = FAIL).
+
+    JAX_PLATFORMS=cpu python scripts/bench_obs.py --out BENCH_obs_pr19.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="also write the receipt JSON here")
+    args = parser.parse_args()
+
+    from bench import bench_obs
+
+    results = bench_obs()
+    if results is None:
+        print("obs bench failed (child produced no results)", file=sys.stderr)
+        return 1
+    payload = json.dumps(results, indent=2)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,11 @@
 #            serve_prefix_* warm-TTFT / hit-rate keys, serve_chaos_*
 #            robustness keys, serve_router_* failover/drain keys
 #            (latencies lower-is-better; every receipt's keys stay
-#            enforced, missing metric = FAIL)
+#            enforced, missing metric = FAIL); when a BENCH_obs_*.json is
+#            committed the observability child (scripts/bench_obs.py)
+#            runs too and its obs_overhead_frac (lower-is-better, <=3%
+#            budget) / obs_trace_linked / obs_metrics_valid keys merge
+#            into the same baseline
 #   data     the streaming packed data plane A/B (mix -> pack_stream vs
 #            pad-to-max on the pinned ragged corpus) vs the last committed
 #            BENCH_data_*.json — packed tokens/s speedup, padding waste

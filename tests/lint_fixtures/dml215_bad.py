@@ -1,0 +1,35 @@
+"""DML215 bad fixture: metric series (or whole families) minted PER
+REQUEST inside serve loops — label values carrying request ids /
+idempotency tokens / trace ids, so the registry grows with traffic and
+never shrinks.
+
+Static lint corpus — never imported or executed. Expected findings: 4.
+"""
+
+
+def per_request_series(metrics, requests):
+    fam = metrics.counter("serve_requests_total", labels=("rid",))
+    for req in requests:
+        fam.labels(rid=req.rid).inc()  # BAD: one series per request id
+    return fam
+
+
+def token_label_loop(fam, queue):
+    while queue:
+        req = queue.pop()
+        fam.labels(token=req.token).observe(req.latency)  # BAD: token label
+    return fam
+
+
+def flow_aware_label(fam, batches):
+    for batch in batches:
+        key = batch["request_id"]
+        fam.labels(tenant=key).inc()  # BAD: key binds to batch["request_id"]
+    return fam
+
+
+def family_per_request(registry, requests):
+    for req in requests:
+        # BAD: an f-string family name mints one FAMILY per request
+        registry.counter(f"serve_latency_{req.rid}").inc()
+    return registry

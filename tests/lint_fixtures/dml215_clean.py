@@ -1,0 +1,38 @@
+"""DML215 clean fixture: the bounded-cardinality patterns — series
+handles resolved ONCE and keyed by bounded vocabularies (statuses,
+replica names), constant family names, readbacks of already-bounded
+dimensions.
+
+Static lint corpus — never imported or executed. Expected findings: 0.
+"""
+
+TERMINAL = ("ok", "cancelled", "error")
+
+
+def prebound_handles(metrics, requests):
+    fam = metrics.counter("terminal_total", labels=("status",))
+    handles = {s: fam.labels(status=s) for s in TERMINAL}
+    for req in requests:
+        handles[req.status].inc()  # hot loop touches pre-bound children only
+    return fam
+
+
+def bounded_label_in_loop(registry, replicas):
+    g = registry.gauge("breaker_state", labels=("replica",))
+    for name in replicas:  # a fixed deployment set, not per-request traffic
+        g.labels(replica=name).set(0)
+    return g
+
+
+def constant_family_in_loop(registry, requests):
+    for _ in requests:
+        # a constant name re-registers the SAME family (registry dedups)
+        registry.counter("serve_requests_total").inc()
+    return registry
+
+
+def numpy_histogram_is_not_a_registry(np, request_latencies):
+    out = []
+    for window in request_latencies:
+        out.append(np.histogram(window, bins=8))  # stats, not a metric family
+    return out

@@ -1075,3 +1075,106 @@ def test_committed_tier1_receipt_satisfies_the_gate():
     assert run_gate(receipts[-1], current=receipts[-1]) == 0
     assert receipt["gate"]["tier1_exit_ok"] == 1
     assert receipt["gate"]["tier1_suite_wall_s"] < 870.0
+
+
+# ------------------------------------------- serve suite: observability
+
+OBS_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "obs_overhead_frac": 0.01,
+        "obs_trace_linked": 1,
+        "obs_metrics_valid": 1,
+    },
+}
+
+
+def test_obs_gate_passes_against_itself(tmp_path):
+    base = _write(tmp_path, "BENCH_obs_base.json", OBS_RECEIPT)
+    assert run_gate(base, current=dict(OBS_RECEIPT)) == 0
+
+
+def test_obs_overhead_is_lower_is_better(tmp_path, capsys):
+    """The instrumentation overhead fraction is a latency-class metric:
+    growing past the wide latency tolerance FAILS naming the key,
+    shrinking (cheaper tracing) always passes."""
+    heavy = json.loads(json.dumps(OBS_RECEIPT))
+    heavy["gate"]["obs_overhead_frac"] = 0.05  # 5x the committed cost
+    base = _write(tmp_path, "BENCH_obs_base.json", OBS_RECEIPT)
+    assert run_gate(base, current=heavy) == 1
+    assert "obs_overhead_frac" in capsys.readouterr().out
+    free = json.loads(json.dumps(OBS_RECEIPT))
+    free["gate"]["obs_overhead_frac"] = 0.0
+    assert run_gate(base, current=free) == 0
+
+
+def test_obs_contracts_are_pass_fail(tmp_path, capsys):
+    """Trace linkage and exposition validity are binary contracts: a
+    single orphan span (linked -> 0) or an unparseable metrics page
+    FAILS outright."""
+    base = _write(tmp_path, "BENCH_obs_base.json", OBS_RECEIPT)
+    for key in ("obs_trace_linked", "obs_metrics_valid"):
+        broken = json.loads(json.dumps(OBS_RECEIPT))
+        broken["gate"][key] = 0
+        assert run_gate(base, current=broken) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_obs_missing_metric_fails(tmp_path, capsys):
+    """An obs metric that silently vanishes is a FAIL, like every suite."""
+    current = {"gate": {"obs_overhead_frac": 0.0, "obs_trace_linked": 1}}
+    base = _write(tmp_path, "BENCH_obs_base.json", OBS_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_serve_suite_merges_obs_receipts(tmp_path, monkeypatch):
+    """The serve suite's merged baseline folds BENCH_obs_*.json in next
+    to the serve receipts: dropping an obs key from the current run
+    FAILS even when every serve key is healthy."""
+    import bench as bench_mod
+
+    serve = {"gate": {"serve_p99_ttft_s": 1.5, "serve_tokens_per_sec_speedup": 3.0}}
+    obs = {"gate": dict(OBS_RECEIPT["gate"])}
+    _write(tmp_path, "BENCH_serve_a.json", serve)
+    _write(tmp_path, "BENCH_obs_pr19.json", obs)
+    monkeypatch.setattr(
+        bench_mod.os.path, "dirname", lambda p, _real=bench_mod.os.path.dirname: str(tmp_path)
+    )
+    both = {"gate": {**serve["gate"], **obs["gate"]}}
+    cur = _write(tmp_path, "cur.json", both)
+    assert gate_main(["--gate", "--suite", "serve", "--current", cur]) == 0
+    partial = _write(tmp_path, "partial.json", serve)
+    assert gate_main(["--gate", "--suite", "serve", "--current", partial]) == 1
+
+
+def test_committed_obs_receipt_satisfies_the_gate():
+    """The committed PR 19 receipt must pass its own gate and meet the
+    acceptance floors: instrumentation overhead inside the 3% budget,
+    every request's spans linked into one trace with ZERO orphans
+    through the kill-one-drain-one router drill, and both metrics
+    surfaces parsing as valid Prometheus text."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_obs_pr19.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    assert gate["obs_overhead_frac"] <= 0.03
+    assert gate["obs_trace_linked"] == 1
+    assert gate["obs_metrics_valid"] == 1
+    assert receipt["value_source"] == "cpu_smoke"
+    overhead = receipt["overhead"]
+    assert overhead["spans_journaled"] > 0
+    assert overhead["engine_metrics_valid"] is True
+    assert overhead["leaked_blocks"] == 0
+    drill = receipt["router_drill"]
+    # the drill is real: a replica died mid-trace, another drained out,
+    # and every logical request still resolved to exactly one trace
+    assert drill["kill_fired"] is True and drill["drain_fired"] is True
+    assert drill["orphan_spans"] == 0
+    assert drill["traces"] == drill["requests"]
+    assert drill["all_terminal"] is True
+    assert drill["leaked_blocks"] == 0
+    assert drill["metrics_families"] > 0

@@ -50,6 +50,7 @@ BAD_EXPECT = {
     "DML212": 4,
     "DML213": 4,
     "DML214": 4,
+    "DML215": 4,
     "DML301": 2,
     "DML302": 2,
 }

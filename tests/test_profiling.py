@@ -103,7 +103,10 @@ def test_analyze_trace_json_schema(monkeypatch, capsys):
     monkeypatch.setattr(mod, "roofline", lambda d, steps=30: (peaks, rows))
     assert mod.main(["/tmp/whatever", "--json", "--steps", "7"]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["version"] == 1
+    # v2 is ADDITIVE over v1: the roofline keys are locked unchanged
+    # (serve-journal inputs add a "serve" object instead — see
+    # tests/test_observability.py)
+    assert out["version"] == 2
     assert out["steps"] == 7
     assert out["peaks"] == peaks and out["rows"] == rows
 

@@ -500,6 +500,99 @@ class TestRouterTelemetry:
 
 
 # ---------------------------------------------------------------------------
+# request-scoped tracing across failure (PR 19)
+# ---------------------------------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_failover_rotates_token_but_keeps_trace(self, tmp_path):
+        """A heartbeat failover definitively cancels and resubmits under a
+        FRESH token (at-most-once), but the trace id never rotates — the
+        retry's spans land in the SAME causal trace as the original
+        placement."""
+        from dmlcloud_tpu.telemetry.journal import linked_trace_report
+
+        j = SpanJournal(tmp_path / "telemetry", rank=0, ring_size=256)
+        journal_mod.activate(j)
+        try:
+            router, clock = _stub_router(
+                n=2, heartbeat_timeout_s=1.0, engine_kw={"steps_to_finish": 5}
+            )
+            rid = router.submit(list(range(4)), 4)
+            router.step()
+            rec = router._records[rid]
+            trace_before = rec.trace
+            router.stall_replica(rec.replica, 10)
+            clock.advance(2.0)
+            router.step()
+            assert rec.retries == 1 and rec.token.endswith(".f1")
+            assert rec.trace == trace_before == f"tr-{rid}"
+            router.run(max_steps=50)
+            assert router.status(rid) == "ok"
+        finally:
+            journal_mod.deactivate()
+        report = linked_trace_report(j.tail(256))
+        assert report["orphans"] == []
+        spans = report["traces"][f"tr-{rid}"]
+        kinds = [r["kind"] for r in spans]
+        # original placement, the failover, and the re-placement all link
+        assert kinds.count("route") == 2 and kinds.count("failover") == 1
+
+    def test_kill_one_drain_one_drill_has_zero_orphans(self, tmp_path):
+        """The router drill's journal walk: kill a replica mid-flight,
+        drain another — every request-scoped span still carries its trace
+        id (zero orphans) and every submitted request resolves to exactly
+        one trace."""
+        from dmlcloud_tpu.telemetry.journal import linked_trace_report
+
+        j = SpanJournal(tmp_path / "telemetry", rank=0, ring_size=512)
+        journal_mod.activate(j)
+        try:
+            router, _ = _stub_router(n=3, engine_kw={"steps_to_finish": 4})
+            rids = [router.submit(list(range(i, i + 4)), 4) for i in range(6)]
+            router.step()
+            router.kill_replica("r0", "drill")
+            router.run(max_steps=30)
+            router.drain_replica("r1", "drill")
+            router.run(max_steps=60)
+            assert all(router.status(r) in TERMINAL_STATUSES for r in rids)
+        finally:
+            journal_mod.deactivate()
+        report = linked_trace_report(j.tail(512))
+        assert report["orphans"] == []
+        assert set(report["traces"]) == {f"tr-{r}" for r in rids}
+        for spans in report["traces"].values():
+            assert spans  # no empty trace
+
+    def test_exhausted_retries_stamp_the_trace_status(self, tmp_path):
+        """A request that burns its whole retry budget ends ``error`` AND
+        its trace says so: the terminal fault span carries the trace id,
+        so ``linked_trace_report`` surfaces the status per trace."""
+        from dmlcloud_tpu.telemetry.journal import linked_trace_report
+
+        j = SpanJournal(tmp_path / "telemetry", rank=0, ring_size=256)
+        journal_mod.activate(j)
+        try:
+            router, _ = _stub_router(
+                n=2, max_retries=1, breaker_threshold=100,
+                engine_kw={"steps_to_finish": 5},
+            )
+            for rep in router.replicas.values():
+                rep.engine.fail_next = 100
+            rid = router.submit(list(range(4)), 4)
+            for _ in range(10):
+                router.step()
+                if router.idle:
+                    break
+            assert router.status(rid) == "error"
+        finally:
+            journal_mod.deactivate()
+        report = linked_trace_report(j.tail(256))
+        assert report["orphans"] == []
+        assert report["statuses"][f"tr-{rid}"] == "error"
+
+
+# ---------------------------------------------------------------------------
 # ledger: per-tenant percentiles survive eviction (satellite)
 # ---------------------------------------------------------------------------
 

@@ -52,6 +52,11 @@ V1_KINDS = {
     # multi-replica router (PR 15): placement, dead-replica resubmission,
     # router-coordinated drain of one replica
     "route", "failover", "replica_drain",
+    # Medusa decoding (PR 16): draftless speculative rounds
+    "medusa",
+    # observability plane (PR 19): admission into a decode slot, prefix
+    # cache lookups, copy-on-write forks, SLO burn-rate alerts
+    "admission", "prefix_lookup", "cow_fork", "slo_alert",
 }
 
 #: Core fields every v1 record carries, with their types.
