@@ -2649,6 +2649,7 @@ _GATE_LOWER_IS_BETTER = frozenset(
         "tier1_suite_wall_s",
         "lint_cold_wall_s",
         "lint_warm_wall_s",
+        "verify_wall_s",
     }
 )
 
@@ -3015,13 +3016,38 @@ def gate_main(argv: list) -> int:
         # receipt. The child refuses to emit a receipt if the warm run
         # changes the findings, and stamps lint_incremental_ok=0 when warm
         # exceeds its budget fraction of cold — either FAILS here (a
-        # vanished metric fails too, like every other suite).
-        baseline = _opt("--baseline") or _latest_receipt("lint")
+        # vanished metric fails too, like every other suite). PR 20's IR
+        # verifier receipts (BENCH_verify_*.json: verify_wall_s + the
+        # verify_caught_donation / verify_caught_oom defect-detection
+        # bits) merge into the SAME baseline, so a verifier that goes
+        # blind — or a vanished verify key — fails the lint suite too.
+        explicit = _opt("--baseline")
+        if explicit is not None:
+            baseline = explicit
+        else:
+            baseline = _merged_baseline(["BENCH_lint_*.json", "BENCH_verify_*.json"])
         if baseline is None:
             print("gate: FAIL — no --baseline and no committed BENCH_lint_*.json", file=sys.stderr)
             return 2
         current = _opt("--current")
-        if current is None:
+        if current is None and (
+            not isinstance(baseline, dict) or any(
+                k.startswith("verify_") for k in baseline["gate"]
+            )
+        ):
+            # the merged baseline carries verify_* keys, so the current
+            # run must produce them too: both children run and their gate
+            # sections merge (missing either child = FAIL)
+            print("gate: running the lint cold/warm A/B (bench_lint child)...", file=sys.stderr)
+            cur_l = bench_lint()
+            print("gate: running the IR verifier A/B (bench_verify child)...", file=sys.stderr)
+            cur_v = bench_verify()
+            if cur_l is None or cur_v is None:
+                which = "lint" if cur_l is None else "verify"
+                print(f"gate: FAIL — {which} bench child produced no results", file=sys.stderr)
+                return 2
+            current = {"gate": {**_gate_metrics(cur_l), **_gate_metrics(cur_v)}}
+        elif current is None:
             print("gate: running the lint cold/warm A/B (bench_lint child)...", file=sys.stderr)
             current = bench_lint()
             if current is None:
@@ -3045,6 +3071,37 @@ def bench_lint(timeout_s: int = 300) -> dict | None:
         try:
             proc = subprocess.run(
                 cmd, cwd=here, timeout=timeout_s,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr or "")
+            return None
+        try:
+            with open(out) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+def bench_verify(timeout_s: int = 300) -> dict | None:
+    """Run scripts/bench_verify.py (CPU-pinned child — the IR verifier
+    needs jax, unlike the pure-stdlib linter) and return its receipt dict:
+    verify wall seconds over the pinned train+serve configs plus the
+    ``verify_caught_donation``/``verify_caught_oom`` defect-detection
+    bits. None if the child failed or produced no receipt."""
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "verify_receipt.json")
+        cmd = [sys.executable, os.path.join(here, "scripts", "bench_verify.py"), "-o", out]
+        try:
+            proc = subprocess.run(
+                cmd, cwd=here, timeout=timeout_s, env=env,
                 stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
             )
         except subprocess.TimeoutExpired:

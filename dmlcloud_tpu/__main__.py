@@ -7,6 +7,12 @@
   its equivalent is buried in run logs (util/logging.py:131-173).
 - ``lint``: the AST-based TPU-hazard linter (doc/lint.md) — enforces the
   overlap engine's sync-point contract on CPU, no jax import needed.
+- ``verify``: the IR-level preflight (doc/lint.md DML6xx) — traces the
+  step programs that files with a ``dml_verify_programs()`` hook register,
+  compiles them on CPU, and audits the jaxpr + compiled artifact: donation
+  effectiveness, mesh/collective resolution, baked-in host transfers,
+  HBM-budget fit, signature surface. What ``lint`` *claims* from source,
+  ``verify`` *proves* on the program XLA will actually run.
 - ``timeline``: merge a telemetry-armed run's per-host span journals
   (doc/observability.md) into one Perfetto/Chrome-trace JSON — open it in
   https://ui.perfetto.dev or chrome://tracing and every rank's epochs,
@@ -26,6 +32,7 @@
     python -m dmlcloud_tpu --json           # machine-readable diagnostics
     python -m dmlcloud_tpu diag [--json] [--run RUN_DIR] [--corpus DIR]
     python -m dmlcloud_tpu lint [paths...] [--json] [--list-rules]
+    python -m dmlcloud_tpu verify [paths...] [--json] [--hbm-budget 16G]
     python -m dmlcloud_tpu timeline RUN_DIR [-o trace.json] [--by-request]
     python -m dmlcloud_tpu trace RUN_DIR --rid 17   # or --trace tr-17
     python -m dmlcloud_tpu top --url http://127.0.0.1:9100/metrics --once
@@ -38,7 +45,7 @@ import argparse
 import json
 import sys
 
-_SUBCOMMANDS = ("diag", "lint", "timeline", "trace", "top")
+_SUBCOMMANDS = ("diag", "lint", "verify", "timeline", "trace", "top")
 
 
 def _timeline_main(argv) -> int:
@@ -614,6 +621,10 @@ def main(argv=None) -> int:
         from .lint.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "verify":
+        from .lint.ir import verify_main
+
+        return verify_main(argv[1:])
     if argv and argv[0] == "timeline":
         return _timeline_main(argv[1:])
     if argv and argv[0] == "trace":

@@ -35,6 +35,17 @@ DML206      ``lax.scan``/``nn.scan`` over a layer stack without a remat
             policy — activation memory grows with depth
 DML301      shared attribute locked on one side of a thread boundary only
 DML302      ``time.sleep`` polling loop where an Event/Condition exists
+DML6xx      the IR pass (``lint --ir`` / ``python -m dmlcloud_tpu
+            verify``): rules over the TRACED program — jaxpr + compiled
+            artifact — not the source. DML601 donation declared but
+            silently dropped by jit (the compiled executable aliases
+            nothing); DML602 collective/sharding axes that don't resolve
+            against the actual mesh; DML603 host callbacks baked into a
+            step program; DML604 estimated peak memory over a declared
+            HBM budget; DML605 enumerated signature surface over the
+            TraceGuard budget. Checks live in rules_ir.py (stdlib); the
+            tracer in lint/ir.py is the ONE jax-importing lint module
+            and is loaded lazily.
 DML501      ``KVBlockPool.alloc``/``PrefixCache.lock`` reference leaked on
             some path out of the owning scope (whole-program, path- and
             helper-aware — subsumes the DML212 identifier heuristic)
@@ -66,6 +77,7 @@ with bad/good examples: doc/lint.md.
 
 from .engine import (  # noqa: F401
     Finding,
+    IR_RULES,
     LintError,
     PROJECT_RULES,
     RULES,
@@ -80,6 +92,7 @@ from . import rules_perf  # noqa: F401  — DML205/206 donation & remat contract
 from . import rules_data  # noqa: F401  — DML209 packed segment_ids contract
 from . import rules_concurrency  # noqa: F401  — DML3xx concurrency family
 from . import lifecycle  # noqa: F401  — DML5xx whole-program lifecycle family
+from . import rules_ir  # noqa: F401  — DML6xx IR family (checks only; the jax tracer is lint/ir.py, loaded lazily)
 from .cache import DEFAULT_CACHE_PATH, LintCache  # noqa: F401
 from .callgraph import ProjectGraph, summarize_module  # noqa: F401
 from .fix import FIXABLE_RULES, apply_fixes, apply_suppressions  # noqa: F401
@@ -90,6 +103,7 @@ __all__ = [
     "DEFAULT_CACHE_PATH",
     "FIXABLE_RULES",
     "Finding",
+    "IR_RULES",
     "LintCache",
     "LintError",
     "PROJECT_RULES",

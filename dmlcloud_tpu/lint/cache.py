@@ -40,17 +40,20 @@ DEFAULT_CACHE_PATH = ".dmllint_cache.json"
 _CACHE_VERSION = 1
 
 
-def _config_signature(select, ignore) -> str:
+def _config_signature(select, ignore, ir=False) -> str:
     """Hash of everything that changes findings without changing sources:
-    the registered rule ids (module + project) and the select/ignore sets."""
-    from .engine import PROJECT_RULES, RULES
+    the registered rule ids (module + project + IR), the select/ignore
+    sets, and whether the IR pass is armed (an ``--ir`` run and a plain
+    run must never reuse each other's entries)."""
+    from .engine import IR_RULES, PROJECT_RULES, RULES
 
     blob = json.dumps(
         {
             "version": _CACHE_VERSION,
-            "rules": sorted(RULES) + sorted(PROJECT_RULES),
+            "rules": sorted(RULES) + sorted(PROJECT_RULES) + sorted(IR_RULES),
             "select": sorted(select) if select else None,
             "ignore": sorted(ignore) if ignore else None,
+            "ir": bool(ir),
         },
         sort_keys=True,
     )
@@ -61,10 +64,23 @@ class LintCache:
     """Plan/store half-pair used by ``lint_paths``: :meth:`plan` splits the
     file list into re-lint vs reuse, :meth:`store` persists the merged run."""
 
-    def __init__(self, path: str | os.PathLike, select=None, ignore=None):
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        select=None,
+        ignore=None,
+        ir=False,
+        git_state: tuple[str, frozenset[str]] | None = None,
+    ):
+        #: ``git_state`` (from ``--changed``): ``(HEAD sha, dirty paths)``.
+        #: When the cache was written at the SAME commit, files git reports
+        #: clean are reused without re-hashing — content-identical by
+        #: construction, so findings stay byte-identical to a cold run.
         self.path = os.fspath(path)
-        self.signature = _config_signature(select, ignore)
+        self.signature = _config_signature(select, ignore, ir)
+        self.git_state = git_state
         self.entries: dict[str, dict] = {}
+        self._cached_head: str | None = None
         self._hashes: dict[str, str] = {}
         self._load()
 
@@ -76,6 +92,8 @@ class LintCache:
             return
         if not isinstance(data, dict) or data.get("config") != self.signature:
             return
+        head = data.get("head")
+        self._cached_head = head if isinstance(head, str) else None
         files = data.get("files")
         if isinstance(files, dict):
             self.entries = files
@@ -89,14 +107,31 @@ class LintCache:
         files = [os.fspath(p) for p in files]
         changed: set[str] = set()
         candidates: dict[str, dict] = {}
+        # git-trust fast path (--changed): at the SAME recorded HEAD, a
+        # git-clean file's content cannot differ from what was hashed —
+        # reuse its entry without re-reading the file at all
+        trust_clean: frozenset[str] = frozenset()
+        if self.git_state is not None and self.git_state[0] == self._cached_head:
+            dirty = self.git_state[1]
+            trust_clean = frozenset(
+                p for p in files if os.path.abspath(p) not in dirty
+            )
         for p in files:
+            entry = self.entries.get(p)
+            if (
+                p in trust_clean
+                and entry is not None
+                and entry.get("gc") is True  # was ALSO clean when stored
+                and entry.get("summary") is not None
+            ):
+                candidates[p] = entry
+                continue
             try:
                 with open(p, "rb") as f:
                     self._hashes[p] = hashlib.sha256(f.read()).hexdigest()
             except OSError:
                 changed.add(p)
                 continue
-            entry = self.entries.get(p)
             if (
                 entry is not None
                 and entry.get("sha") == self._hashes[p]
@@ -161,7 +196,14 @@ class LintCache:
                 "axes": list(r.get("axes", ())),
                 "sup": r.get("sup"),
             }
+            if self.git_state is not None:
+                # the git-trust flag: only an entry stored CLEAN at this
+                # HEAD may later skip hashing (a dirty-at-store entry could
+                # be reverted to clean with different content than hashed)
+                files[path]["gc"] = os.path.abspath(path) not in self.git_state[1]
         payload = {"config": self.signature, "files": files}
+        if self.git_state is not None:
+            payload["head"] = self.git_state[0]
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w", encoding="utf-8") as f:

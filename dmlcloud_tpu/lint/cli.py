@@ -31,13 +31,26 @@ Whole-program / workflow flags:
   rules only — the pre-PR-17 behavior).
 - ``--cache [PATH]`` enables the incremental cache (lint/cache.py);
   unchanged files and everything they can't affect are reused.
+- ``--changed`` (implies ``--cache``) additionally trusts git metadata:
+  a file clean in ``git status`` now, clean when its entry was stored,
+  under the same ``HEAD``, is reused without re-hashing its content.
+  Any condition failing falls back to the content-hash check, so output
+  stays identical to a cold run; without git metadata it degrades to
+  plain ``--cache``. ``scripts/lint_gate.sh`` passes both by default.
 - ``--baseline PATH`` filters findings recorded in a baseline file;
   ``--write-baseline PATH`` freezes the current findings into one.
 - ``--fix`` applies the mechanical autofixes (lint/fix.py) and re-lints;
   ``--fix-suppress`` appends suppression directives to whatever remains.
+- ``--ir`` adds the DML6xx IR pass (lint/ir.py): files registering a
+  ``dml_verify_programs()`` hook get their programs traced/compiled on
+  CPU and the jaxpr + compiled artifact audited. The ONE flag that needs
+  jax — everything else stays pure stdlib. Findings merge into the same
+  stream, cache, and baseline machinery (a warm ``--ir`` run replays
+  cached IR findings without importing jax).
 
 Exit codes: 0 clean, 1 findings, 2 parse/usage error. Pure stdlib — no
-jax import, safe to run anywhere (pre-commit hooks, CPU-only CI).
+jax import (unless ``--ir``), safe to run anywhere (pre-commit hooks,
+CPU-only CI).
 """
 
 from __future__ import annotations
@@ -47,19 +60,52 @@ import json
 import sys
 
 from .cache import DEFAULT_CACHE_PATH
-from .engine import PARSE_ERROR_RULE, PROJECT_RULES, RULES, expand_rule_ids, iter_python_files, lint_paths
+from .engine import (
+    IR_RULES, PARSE_ERROR_RULE, PROJECT_RULES, RULES, expand_rule_ids,
+    iter_python_files, lint_paths,
+)
 
 
 def _parse_ids(spec: str) -> list[str]:
     ids = [p.strip() for p in spec.split(",") if p.strip()]
     expanded, unknown = expand_rule_ids(ids)
     if unknown:
-        known = ", ".join(sorted(set(RULES) | set(PROJECT_RULES)))
+        known = ", ".join(sorted(set(RULES) | set(PROJECT_RULES) | set(IR_RULES)))
         raise argparse.ArgumentTypeError(
             f"unknown rule id(s)/family wildcard(s) {', '.join(unknown)}; "
             f"known: {known} (families like DML2xx work too)"
         )
     return expanded
+
+
+def _git_state() -> "tuple[str, frozenset[str]] | None":
+    """``(HEAD sha, absolute dirty paths)`` for ``--changed``, or None
+    when git metadata is unavailable (not a checkout, no git binary) —
+    the cache then degrades to plain content hashing."""
+    import os
+    import subprocess
+
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        status = subprocess.run(
+            ["git", "status", "--porcelain", "--no-renames"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    dirty = frozenset(
+        os.path.abspath(os.path.join(top, line[3:].strip().strip('"')))
+        for line in status.splitlines()
+        if len(line) > 3
+    )
+    return head, dirty
 
 
 def _github_escape(msg: str) -> str:
@@ -130,9 +176,22 @@ def main(argv=None) -> int:
         help="skip the whole-program DML5xx pass (module-local rules only)",
     )
     parser.add_argument(
+        "--ir", action="store_true",
+        help="add the DML6xx IR pass: trace/compile the programs that files "
+        "with a dml_verify_programs() hook register and audit the jaxpr + "
+        "compiled artifact (needs jax; CPU is enough)",
+    )
+    parser.add_argument(
         "--cache", nargs="?", const=DEFAULT_CACHE_PATH, default=None, metavar="PATH",
         help=f"incremental cache file (default when given bare: {DEFAULT_CACHE_PATH}); "
         "unchanged files and their unaffected importers are reused",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="with --cache (implied if absent): trust git metadata — at the "
+        "same HEAD the cache was written, files 'git status' reports clean "
+        "skip even the content re-hash; findings stay identical to a cold "
+        "run (no-op outside a git checkout)",
     )
     parser.add_argument(
         "--baseline", default=None, metavar="PATH",
@@ -170,9 +229,9 @@ def main(argv=None) -> int:
         return 2
 
     if args.list_rules:
-        for rid in sorted(set(RULES) | set(PROJECT_RULES)):
-            info = RULES.get(rid) or PROJECT_RULES[rid]
-            scope = " [project]" if rid in PROJECT_RULES else ""
+        for rid in sorted(set(RULES) | set(PROJECT_RULES) | set(IR_RULES)):
+            info = RULES.get(rid) or PROJECT_RULES.get(rid) or IR_RULES[rid]
+            scope = " [project]" if rid in PROJECT_RULES else (" [ir]" if rid in IR_RULES else "")
             print(f"{rid}  {info.title}{scope}")
         return 0
 
@@ -183,6 +242,19 @@ def main(argv=None) -> int:
             print(f"lint: cannot read baseline {args.baseline}", file=sys.stderr)
             return 2
 
+    if args.ir:
+        try:
+            from . import ir as _ir_probe  # noqa: F401 — needs jax
+        except Exception as e:
+            print(f"lint: --ir needs jax, which failed to import: {e}", file=sys.stderr)
+            return 2
+
+    git_state = None
+    if args.changed:
+        if args.cache is None:
+            args.cache = DEFAULT_CACHE_PATH
+        git_state = _git_state()
+
     def run():
         return lint_paths(
             args.paths,
@@ -191,6 +263,8 @@ def main(argv=None) -> int:
             jobs=args.jobs,
             callgraph=not args.no_callgraph,
             cache=args.cache,
+            ir=args.ir,
+            git_state=git_state,
         )
 
     files_scanned = sum(1 for _ in iter_python_files(args.paths))

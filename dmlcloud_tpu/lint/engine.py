@@ -151,6 +151,26 @@ def project_rule(rule_id: str, title: str):
     return deco
 
 
+#: IR-pass rule id -> RuleInfo; populated by ``@ir_rule`` (rules_ir.py).
+#: These run over TRACED programs (jaxpr + compiled artifact), never over
+#: source — the AST/dataflow/call-graph passes cannot see them by
+#: construction. The checks themselves are stdlib-only (they duck-type the
+#: traced artifacts); only the tracer in :mod:`~dmlcloud_tpu.lint.ir`
+#: imports jax, so this registry keeps the package import jax-free.
+IR_RULES: dict[str, RuleInfo] = {}
+
+
+def ir_rule(rule_id: str, title: str):
+    """Register an IR rule ``check(program) -> Iterator[Finding]`` taking a
+    :class:`~dmlcloud_tpu.lint.ir.TracedProgram`."""
+
+    def deco(fn):
+        IR_RULES[rule_id] = RuleInfo(rule_id, title, fn)
+        return fn
+
+    return deco
+
+
 def _id_matches(rule_id: str, spec: str) -> bool:
     """Whether ``spec`` selects ``rule_id``: exact id, ``all``, or a family
     wildcard like ``DML2xx`` (trailing ``xx`` matches any digits)."""
@@ -167,7 +187,7 @@ def expand_rule_ids(ids: Iterable[str]) -> tuple[list[str], list[str]]:
     and an unregistered exact id both land in ``unknown``."""
     expanded: list[str] = []
     unknown: list[str] = []
-    all_ids = sorted(set(RULES) | set(PROJECT_RULES))
+    all_ids = sorted(set(RULES) | set(PROJECT_RULES) | set(IR_RULES))
     for spec in ids:
         matched = [rid for rid in all_ids if _id_matches(rid, spec)]
         if matched:
@@ -860,6 +880,8 @@ def lint_paths(
     callgraph: bool = True,
     cache: str | os.PathLike | None = None,
     stats: dict | None = None,
+    ir: bool = False,
+    git_state: "tuple[str, frozenset[str]] | None" = None,
 ) -> list[Finding]:
     """Lint files and/or directories (recursive); returns sorted findings.
 
@@ -879,7 +901,13 @@ def lint_paths(
     whose initializer installs the shared pass-1 registries once per
     worker; on a single-core host the pool is a pure loss (measured in
     BENCH_lint_pr05) so ``jobs`` silently collapses to 1 there. Findings
-    merge in path order either way, so output is deterministic."""
+    merge in path order either way, so output is deterministic.
+
+    ``ir=True`` adds the DML6xx IR pass (lint/ir.py — the ONE jax-needing
+    pass): files defining a ``dml_verify_programs()`` hook get their
+    programs traced/compiled on CPU and audited, findings merging into
+    the same stream (and the same cache entries — a warm ``--ir`` run
+    replays them byte-identically without importing jax)."""
     files = list(iter_python_files(paths))
     if jobs > 1 and (os.cpu_count() or 1) == 1:
         jobs = 1
@@ -891,7 +919,8 @@ def lint_paths(
     if cache is not None:
         from .cache import LintCache
 
-        cache_obj = LintCache(cache, select=select, ignore=ignore)
+        cache_obj = LintCache(cache, select=select, ignore=ignore, ir=ir,
+                              git_state=git_state)
         to_lint, reused = cache_obj.plan(files)
 
     if project is None:
@@ -949,6 +978,22 @@ def lint_paths(
             pending.append(ctx)
         for ctx in pending:
             results.append(_module_result(ctx, select, ignore, want_summary))
+
+    if ir:
+        # the IR pass runs serially in the parent (it imports jax and
+        # compiles; a process pool would re-pay jax startup per worker) and
+        # merges into each hook file's result BEFORE the cache stores it —
+        # a warm run replays these findings without touching jax at all
+        from . import ir as ir_mod
+
+        for r in results:
+            if not ir_mod.has_hook(r["path"]):
+                continue
+            ir_findings = ir_mod.verify_file(r["path"], select=select, ignore=ignore)
+            if ir_findings:
+                r["findings"] = sorted(
+                    set(r["findings"]) | set(ir_findings), key=Finding.sort_key
+                )
 
     findings: list[Finding] = []
     for entry in reused.values():

@@ -1,0 +1,659 @@
+"""The IR-level verifier: trace, compile, and audit the ACTUAL program.
+
+Every other lint layer (AST rules, dataflow, call graph) reads Python
+source; this one reads what XLA will run. A registered *program* — a
+step function plus abstract argument specs — is staged on CPU::
+
+    jaxpr    = jax.make_jaxpr(fn, axis_env=mesh_axes)(*abstract_args)
+    compiled = jitted.lower(*abstract_args).compile()
+
+and the DML6xx rules (:mod:`~dmlcloud_tpu.lint.rules_ir`) run over the
+jaxpr and the compiled artifact's own ledgers (``memory_analysis``,
+buffer aliasing). That closes the gap between the linter's *claims* and
+the program's *behavior*: jit silently drops a donation on a
+dtype/shape mismatch (DML205 passes the source clean; DML601 reads the
+executable's alias table), a collective axis typo only exists after
+tracing (DML602), host callbacks hide behind call layers (DML603), and
+peak memory is a property of the compiled buffers, not the source
+(DML604).
+
+Three front ends share this module:
+
+- ``python -m dmlcloud_tpu verify [--json] [paths]`` — the preflight
+  subcommand (:func:`verify_main`). It discovers *program hooks*: any
+  ``*.py`` file defining a module-level function named
+  ``dml_verify_programs() -> list[ProgramSpec]`` is imported and its
+  programs verified.
+- ``python -m dmlcloud_tpu lint --ir`` — the same pass folded into the
+  lint CLI/cache/baseline machinery (engine.py threads ``ir=True``
+  through :func:`~dmlcloud_tpu.lint.engine.lint_paths`).
+- the runtime arms — ``TrainingPipeline(verify=...)`` verifies the
+  precompiled train/val executables at stage start (re-using them, no
+  second compile), ``ServeEngine(verify=...)`` audits the engine's
+  signature surface and a representative max-bucket decode step at
+  construction time.
+
+This is the ONE lint module that imports jax — the package import and
+every other front end stay stdlib-only (the DML6xx checks themselves
+live in rules_ir.py and duck-type the traced artifacts).
+
+Suppression comments work unchanged: findings anchor to the step
+function's ``def`` line, so ``# dmllint: disable=DML601`` (or the
+``DML6xx`` family wildcard) on that line applies.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import math
+import os
+import re
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+import numpy as np
+
+import jax
+
+from . import rules_ir  # noqa: F401 — register the DML6xx rules
+from .engine import (
+    PARSE_ERROR_RULE,
+    Finding,
+    IR_RULES,
+    Suppressions,
+    expand_rule_ids,
+    iter_python_files,
+)
+
+__all__ = [
+    "HOOK_NAME",
+    "ProgramSpec",
+    "TracedProgram",
+    "trace_program",
+    "run_ir_rules",
+    "verify_programs",
+    "verify_file",
+    "verify_paths",
+    "has_hook",
+    "load_programs",
+    "verify_main",
+]
+
+#: the module-level discovery hook: a file defining this function is a
+#: *verify target* — the hook returns the file's list of ProgramSpec.
+HOOK_NAME = "dml_verify_programs"
+
+_HOOK_DEF = re.compile(r"(?m)^\s*def\s+" + HOOK_NAME + r"\s*\(")
+
+
+@dataclass
+class ProgramSpec:
+    """One program to verify: a step function plus its abstract call.
+
+    ``fn`` may be a plain function or an already-jitted one. ``args``
+    are abstract specs (``jax.ShapeDtypeStruct`` pytrees — concrete
+    arrays work too but are never materialized on device).
+    ``static_kwargs`` are bound before tracing (and passed to
+    ``lower()`` when ``fn`` is jitted with ``static_argnames``).
+
+    ``donate_argnums`` declares which positional args the program
+    donates — for a plain ``fn`` the tracer jits with exactly these; for
+    a pre-jitted ``fn`` they must mirror what the jit already declares
+    (DML601 audits the declaration against the compiled alias table).
+
+    ``mesh`` is a ``jax.sharding.Mesh`` or ``[(axis, size), ...]``
+    pairs; it becomes the trace's ``axis_env`` and DML602's ground
+    truth. ``hbm_budget_bytes`` arms DML604; ``signature_surface`` /
+    ``signature_budget`` arm DML605. ``compiled`` short-circuits the
+    compile (the runtime arms pass their existing executables).
+    ``compile=False`` restricts the trace to the jaxpr-level checks.
+    """
+
+    name: str
+    fn: Any
+    args: tuple = ()
+    static_kwargs: dict = field(default_factory=dict)
+    donate_argnums: tuple = ()
+    mesh: Any = None
+    hbm_budget_bytes: int | None = None
+    signature_surface: int | None = None
+    signature_budget: int | None = None
+    kind: str = "train"
+    path: str | None = None
+    line: int = 0
+    compiled: Any = None
+    compile: bool = True
+
+
+@dataclass
+class TracedProgram:
+    """What the DML6xx rules see: one program's staged artifacts.
+
+    Pure data — every field is a plain Python value (the rules are
+    stdlib-only), except ``jaxpr``/``compiled`` which rules only probe
+    for ``is None``.
+    """
+
+    name: str
+    kind: str
+    path: str
+    line: int
+    donate_argnums: tuple = ()
+    donated_bytes: int | None = None
+    aliased_bytes: int | None = None
+    donation_warnings: list = field(default_factory=list)
+    mesh_axes: tuple | None = None
+    collective_axes: set = field(default_factory=set)  # {(axis, primitive)}
+    sharding_axes: set = field(default_factory=set)
+    callback_prims: dict = field(default_factory=dict)  # {primitive: count}
+    hbm_budget_bytes: int | None = None
+    peak_bytes: int | None = None
+    signature_surface: int | None = None
+    signature_budget: int | None = None
+    trace_error: str | None = None
+    jaxpr: Any = None
+    compiled: Any = None
+    trace_ms: float = 0.0
+
+
+# ----------------------------------------------------------------- tracing
+
+
+def _nbytes(tree: Any) -> int:
+    """Total bytes of a pytree of shaped values (abstract or concrete)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(math.prod(shape)) * int(np.dtype(dtype).itemsize)
+    return total
+
+
+def _axis_env(mesh: Any):
+    """Normalize ``mesh`` to (``axis_env`` pairs, axis-name tuple)."""
+    if mesh is None:
+        return None, None
+    if hasattr(mesh, "axis_names"):  # jax.sharding.Mesh
+        names = tuple(str(n) for n in mesh.axis_names)
+        return [(n, int(mesh.shape[n])) for n in names], names
+    pairs = [(str(n), int(s)) for n, s in mesh]
+    return pairs, tuple(n for n, _ in pairs)
+
+
+def _plain_fn(fn: Any) -> Any:
+    """The underlying Python function of a (possibly jitted) callable."""
+    seen = 0
+    while hasattr(fn, "__wrapped__") and seen < 8:
+        fn = fn.__wrapped__
+        seen += 1
+    return fn
+
+
+def _anchor(fn: Any) -> tuple[str | None, int]:
+    """(source file, def line) of the program's function, for findings
+    and therefore for suppression comments."""
+    target = _plain_fn(fn)
+    while isinstance(target, functools.partial):
+        target = target.func
+    try:
+        path = inspect.getsourcefile(target)
+        line = inspect.getsourcelines(target)[1]
+    except (TypeError, OSError):
+        return None, 0
+    return path, int(line)
+
+
+_CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback"})
+
+
+def _iter_sub_jaxprs(params: dict):
+    """Sub-jaxprs hiding in equation params (pjit ``jaxpr``, cond
+    ``branches``, scan ``jaxpr``, custom-call bodies...), duck-typed."""
+    for value in params.values():
+        candidates = value if isinstance(value, (tuple, list)) else (value,)
+        for cand in candidates:
+            inner = getattr(cand, "jaxpr", None)  # ClosedJaxpr
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(cand, "eqns"):  # bare Jaxpr
+                yield cand
+
+
+def _walk_jaxpr(jaxpr: Any, out: TracedProgram, depth: int = 0) -> None:
+    """Collect collective axes, sharding-constraint axes and host
+    callbacks from a jaxpr, recursing into sub-jaxprs."""
+    if depth > 32:  # defensive: jaxprs are DAG-shallow in practice
+        return
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        params = eqn.params
+        if prim in _CALLBACK_PRIMS:
+            out.callback_prims[prim] = out.callback_prims.get(prim, 0) + 1
+        for key in ("axes", "axis_name"):
+            axes = params.get(key)
+            if axes is None:
+                continue
+            for a in axes if isinstance(axes, (tuple, list)) else (axes,):
+                if isinstance(a, str):
+                    out.collective_axes.add((a, prim))
+        if prim == "sharding_constraint":
+            spec = getattr(params.get("sharding"), "spec", None)
+            if spec is not None:
+                for part in spec:
+                    if part is None:
+                        continue
+                    for a in part if isinstance(part, (tuple, list)) else (part,):
+                        if isinstance(a, str):
+                            out.sharding_axes.add(a)
+        for sub in _iter_sub_jaxprs(params):
+            _walk_jaxpr(sub, out, depth + 1)
+
+
+def _mesh_fits(axis_env) -> bool:
+    """Whether the declared mesh can actually be staged through XLA on
+    this host's devices (a 2-axis pod mesh cannot compile on 1 CPU
+    device — the jaxpr-level checks still run)."""
+    if not axis_env:
+        return True
+    needed = 1
+    for _, size in axis_env:
+        needed *= int(size)
+    return needed <= len(jax.devices())
+
+
+def trace_program(spec: ProgramSpec) -> TracedProgram:
+    """Stage one program on CPU and collect everything the DML6xx rules
+    read. Never raises: a failed trace/compile lands in ``trace_error``
+    (reported as a DML999-class finding unless DML602 explains it)."""
+    t0 = time.perf_counter()
+    axis_env, mesh_axes = _axis_env(spec.mesh)
+    if spec.path:
+        path, line = spec.path, spec.line or 1
+    else:
+        path, line = _anchor(spec.fn) if spec.fn is not None else (None, 0)
+        path = path or "<program>"
+        line = line or 1
+    tp = TracedProgram(
+        name=spec.name,
+        kind=spec.kind,
+        path=path,
+        line=line,
+        donate_argnums=tuple(spec.donate_argnums or ()),
+        mesh_axes=mesh_axes,
+        hbm_budget_bytes=spec.hbm_budget_bytes,
+        signature_surface=spec.signature_surface,
+        signature_budget=spec.signature_budget,
+        compiled=spec.compiled,
+    )
+
+    if spec.fn is None:
+        # metadata-only program (e.g. the engine's DML605 signature-surface
+        # check): the budget numbers are the whole story — nothing to trace
+        tp.trace_ms = (time.perf_counter() - t0) * 1e3
+        return tp
+
+    plain = _plain_fn(spec.fn)
+    if spec.static_kwargs:
+        plain = functools.partial(plain, **spec.static_kwargs)
+
+    # 1. the jaxpr — cheap (no XLA), carries the collective/callback story
+    try:
+        closed = jax.make_jaxpr(plain, axis_env=axis_env)(*spec.args)
+        tp.jaxpr = closed
+        _walk_jaxpr(closed.jaxpr, tp)
+    except Exception as e:  # tracing is running user code: anything goes
+        tp.trace_error = f"{type(e).__name__}: {e}"
+
+    # 2. declared donation, from the abstract args alone
+    if tp.donate_argnums:
+        donated = 0
+        for i in tp.donate_argnums:
+            if 0 <= i < len(spec.args):
+                donated += _nbytes(spec.args[i])
+        tp.donated_bytes = donated
+
+    # 3. lower + compile (or adopt the caller's executable) and read the
+    #    artifact's own memory ledger
+    import warnings as _w
+
+    if tp.compiled is None and spec.compile and tp.trace_error is None and _mesh_fits(axis_env):
+        try:
+            with _w.catch_warnings(record=True) as caught:
+                _w.simplefilter("always")
+                if hasattr(spec.fn, "lower"):  # already jitted
+                    lowered = spec.fn.lower(*spec.args, **spec.static_kwargs)
+                else:
+                    jitted = jax.jit(plain, donate_argnums=tp.donate_argnums)
+                    lowered = jitted.lower(*spec.args)
+                tp.compiled = lowered.compile()
+            tp.donation_warnings = [
+                str(w.message) for w in caught if "donated" in str(w.message).lower()
+            ]
+        except Exception as e:
+            tp.trace_error = f"{type(e).__name__}: {e}"
+
+    if tp.compiled is not None:
+        ma = getattr(tp.compiled, "memory_analysis", None)
+        try:
+            ma = ma() if callable(ma) else None
+        except Exception:
+            ma = None
+        if ma is not None:
+            alias = getattr(ma, "alias_size_in_bytes", None)
+            if alias is not None:
+                tp.aliased_bytes = int(alias)
+            sizes = [
+                int(getattr(ma, k, 0) or 0)
+                for k in (
+                    "argument_size_in_bytes",
+                    "output_size_in_bytes",
+                    "temp_size_in_bytes",
+                    "generated_code_size_in_bytes",
+                )
+            ]
+            tp.peak_bytes = max(sum(sizes) - int(alias or 0), 0)
+
+    # 4. abstract fallback for the memory estimate: arguments + traced
+    #    outputs (no temp visibility — an UNDER-estimate, stated as such)
+    if tp.peak_bytes is None and tp.jaxpr is not None:
+        out_avals = getattr(tp.jaxpr, "out_avals", None)
+        if out_avals is not None:
+            tp.peak_bytes = _nbytes(spec.args) + _nbytes(out_avals)
+
+    tp.trace_ms = (time.perf_counter() - t0) * 1e3
+    return tp
+
+
+# ------------------------------------------------------------------- rules
+
+
+def _selected_ids(select, ignore) -> set[str]:
+    selected = set(expand_rule_ids(select)[0]) if select else set(IR_RULES)
+    ignored = set(expand_rule_ids(ignore)[0]) if ignore else set()
+    return (selected & set(IR_RULES)) - ignored
+
+
+def run_ir_rules(
+    tp: TracedProgram,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+) -> list[Finding]:
+    """The selected DML6xx rules over one traced program, plus a DML999
+    finding for a trace failure no rule explains. Suppressions are the
+    caller's job (they need the anchor file's source)."""
+    out: list[Finding] = []
+    for rid in sorted(_selected_ids(select, ignore)):
+        out.extend(IR_RULES[rid].check(tp))
+    if tp.trace_error is not None and not any(f.rule == "DML602" for f in out):
+        out.append(
+            Finding(
+                PARSE_ERROR_RULE,
+                tp.path,
+                tp.line,
+                0,
+                f"could not trace/compile program '{tp.name}': {tp.trace_error}",
+                context=tp.name,
+            )
+        )
+    return sorted(set(out), key=Finding.sort_key)
+
+
+def _apply_suppressions(findings: list[Finding]) -> list[Finding]:
+    """Honor ``# dmllint: disable=...`` comments in each finding's
+    anchor file (parsed once per file)."""
+    sups: dict[str, Suppressions] = {}
+    out = []
+    for f in findings:
+        sup = sups.get(f.path)
+        if sup is None:
+            try:
+                with open(f.path, "r", encoding="utf-8", errors="replace") as fh:
+                    sup = Suppressions.parse(fh.read())
+            except OSError:
+                sup = Suppressions()
+            sups[f.path] = sup
+        if not sup.is_suppressed(f):
+            out.append(f)
+    return out
+
+
+def verify_programs(
+    specs: Iterable[ProgramSpec],
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    stats: dict | None = None,
+) -> list[Finding]:
+    """Trace + audit a batch of programs; suppression comments applied.
+
+    Each program runs under a journaled ``preflight`` span (a no-op
+    without an armed journal), so an armed pipeline/engine records the
+    verify wall time next to its compile spans."""
+    from ..telemetry import journal as _journal
+
+    findings: list[Finding] = []
+    n = 0
+    total_ms = 0.0
+    for spec in specs:
+        n += 1
+        with _journal.span("preflight", label=spec.name, program=spec.kind):
+            tp = trace_program(spec)
+            findings.extend(run_ir_rules(tp, select, ignore))
+        total_ms += tp.trace_ms
+    if stats is not None:
+        stats["programs"] = n
+        stats["trace_ms"] = round(total_ms, 3)
+    return sorted(set(_apply_suppressions(findings)), key=Finding.sort_key)
+
+
+# ---------------------------------------------------------------- discovery
+
+
+def has_hook(path: str | os.PathLike) -> bool:
+    """Cheap textual probe: does this file DEFINE the verify hook?"""
+    try:
+        with open(os.fspath(path), "r", encoding="utf-8", errors="replace") as f:
+            src = f.read()
+    except OSError:
+        return False
+    return _HOOK_DEF.search(src) is not None
+
+
+def load_programs(path: str | os.PathLike) -> list[ProgramSpec]:
+    """Import a file under a private module name (never ``__main__`` —
+    script guards stay cold) and call its verify hook."""
+    import importlib.util
+
+    path = os.fspath(path)
+    mod_name = "_dml_verify_" + re.sub(r"\W", "_", os.path.abspath(path))
+    ispec = importlib.util.spec_from_file_location(mod_name, path)
+    if ispec is None or ispec.loader is None:
+        raise ImportError(f"cannot import {path}")
+    mod = importlib.util.module_from_spec(ispec)
+    sys.modules[mod_name] = mod
+    # the file's own directory joins sys.path while it loads — scripts and
+    # examples import their siblings as if run from their directory
+    file_dir = os.path.dirname(os.path.abspath(path))
+    sys.path.insert(0, file_dir)
+    try:
+        ispec.loader.exec_module(mod)
+        hook = getattr(mod, HOOK_NAME, None)
+        progs = list(hook()) if callable(hook) else []
+    finally:
+        sys.modules.pop(mod_name, None)
+        try:
+            sys.path.remove(file_dir)
+        except ValueError:
+            pass
+    for p in progs:
+        if p.path is None:
+            apath, aline = _anchor(p.fn)
+            if apath is None:
+                p.path, p.line = path, 1
+    return progs
+
+
+def verify_file(
+    path: str | os.PathLike,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    hbm_budget: int | None = None,
+    stats: dict | None = None,
+) -> list[Finding]:
+    """Verify every program one hook file registers. Import/hook errors
+    become a DML999 finding anchored at the file."""
+    path = os.fspath(path)
+    try:
+        specs = load_programs(path)
+    except Exception as e:
+        return [
+            Finding(
+                PARSE_ERROR_RULE, path, 1, 0,
+                f"could not load verify programs: {type(e).__name__}: {e}",
+            )
+        ]
+    if hbm_budget is not None:
+        for s in specs:
+            if s.hbm_budget_bytes is None:
+                s.hbm_budget_bytes = hbm_budget
+    return verify_programs(specs, select, ignore, stats=stats)
+
+
+def verify_paths(
+    paths: Iterable[str | os.PathLike],
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    hbm_budget: int | None = None,
+    stats: dict | None = None,
+) -> list[Finding]:
+    """Discover hook files under ``paths`` and verify their programs."""
+    files = [f for f in iter_python_files(paths) if has_hook(f)]
+    findings: list[Finding] = []
+    n_programs = 0
+    total_ms = 0.0
+    for f in files:
+        fstats: dict = {}
+        findings.extend(verify_file(f, select, ignore, hbm_budget, stats=fstats))
+        n_programs += fstats.get("programs", 0)
+        total_ms += fstats.get("trace_ms", 0.0)
+    if stats is not None:
+        stats["files"] = len(files)
+        stats["programs"] = n_programs
+        stats["trace_ms"] = round(total_ms, 3)
+    return sorted(set(findings), key=Finding.sort_key)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _parse_bytes(text: str) -> int:
+    """``12345``, ``512M``, ``16G``... -> bytes."""
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([kKmMgGtT]?)i?[bB]?\s*", text)
+    if not m:
+        raise ValueError(f"cannot parse byte size {text!r}")
+    scale = {"": 1, "k": 2**10, "m": 2**20, "g": 2**30, "t": 2**40}[m.group(2).lower()]
+    return int(float(m.group(1)) * scale)
+
+
+def verify_main(argv=None) -> int:
+    """``python -m dmlcloud_tpu verify`` — the preflight front end.
+
+    Exit codes mirror the lint CLI: 0 clean, 1 findings, 2 a program
+    that could not be traced (or a usage error)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlcloud_tpu verify",
+        description="IR-level preflight: trace registered step programs on "
+        "CPU and audit the jaxpr + compiled artifact (DML601-DML605) — "
+        "donation effectiveness, mesh/collective resolution, baked-in host "
+        "transfers, HBM-budget fit, signature surface (doc/lint.md).",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["."],
+        help="files/directories to scan for dml_verify_programs() hooks (default: .)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--select", default=None, metavar="IDS",
+        help="comma-separated rule ids / families to run (default: all DML6xx)",
+    )
+    parser.add_argument(
+        "--ignore", default=None, metavar="IDS",
+        help="comma-separated rule ids / families to skip",
+    )
+    parser.add_argument(
+        "--hbm-budget", default=None, metavar="BYTES",
+        help="device HBM budget for DML604 (e.g. 16G, 512M, 987654321) — "
+        "applies to programs that don't declare their own",
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    def ids(spec):
+        if spec is None:
+            return None
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        expanded, unknown = expand_rule_ids(parts)
+        if unknown:
+            print(
+                f"verify: unknown rule id(s) {', '.join(unknown)}; known IR rules: "
+                + ", ".join(sorted(IR_RULES)),
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        return expanded
+
+    try:
+        select, ignore = ids(args.select), ids(args.ignore)
+    except SystemExit as e:
+        return int(e.code or 2)
+    budget = None
+    if args.hbm_budget is not None:
+        try:
+            budget = _parse_bytes(args.hbm_budget)
+        except ValueError as e:
+            print(f"verify: {e}", file=sys.stderr)
+            return 2
+
+    stats: dict = {}
+    findings = verify_paths(args.paths, select, ignore, hbm_budget=budget, stats=stats)
+    trace_error = any(f.rule == PARSE_ERROR_RULE for f in findings)
+    status = "trace_error" if trace_error else ("findings" if findings else "clean")
+    if args.json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "status": status,
+                    "files_scanned": stats.get("files", 0),
+                    "programs": stats.get("programs", 0),
+                    "trace_ms": stats.get("trace_ms", 0.0),
+                    "findings": [f.to_dict() for f in findings],
+                    "counts": {k: counts[k] for k in sorted(counts)},
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        noun = "program" if stats.get("programs", 0) == 1 else "programs"
+        verdict = f"{len(findings)} finding(s)" if findings else "clean"
+        print(
+            f"verify: {verdict} — {stats.get('programs', 0)} {noun} traced in "
+            f"{stats.get('files', 0)} file(s) ({stats.get('trace_ms', 0.0):.0f} ms)"
+        )
+    if trace_error:
+        return 2
+    return 1 if findings else 0

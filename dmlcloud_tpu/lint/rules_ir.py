@@ -1,0 +1,218 @@
+"""DML6xx: rules over the TRACED program, not the source.
+
+Every other rule family reasons about Python text — which means every
+contract they enforce (donation, mesh consistency, signature budgets) is
+a *claim* about what jit will do, not a *proof* about what XLA runs.
+These rules take a :class:`~dmlcloud_tpu.lint.ir.TracedProgram` — the
+jaxpr plus (when tracing got that far) the lowered/compiled artifact —
+and audit the program itself:
+
+- DML601: donation declared but not effective in the compiled
+  executable. jit drops a donated buffer that matches no output
+  (dtype/shape/sharding mismatch) with only a warning; DML205 sees the
+  ``donate_argnums`` in source and passes it clean. The compiled
+  artifact cannot lie: ``memory_analysis().alias_size_in_bytes`` is 0.
+- DML602: collective axis names / ``sharding_constraint`` specs in the
+  jaxpr that don't resolve against the actual mesh (DML201/202 guess
+  from source; this checks the real traced equations).
+- DML603: host callbacks (``pure_callback``/``io_callback``/
+  ``debug_callback``) baked into a step program — a device->host round
+  trip on every step that no source heuristic can prove is in the
+  traced path.
+- DML604: estimated peak device memory (argument + output + temp buffer
+  sizes from XLA's compiled memory analysis, donation savings
+  subtracted) exceeding the program's declared HBM budget — fail at
+  lint time, not OOM at step 1.
+- DML605: the statically enumerated signature surface (bucket
+  cross-product x prefill chunks x spec/medusa modes) exceeding the
+  TraceGuard budget the program declared.
+
+The checks here are pure stdlib: they duck-type the traced artifacts so
+this module imports (and registers into :data:`IR_RULES`) without jax.
+Only the tracer (:mod:`dmlcloud_tpu.lint.ir`) imports jax.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from .engine import Finding, IR_RULES, ir_rule  # noqa: F401  (re-export)
+
+__all__ = ["IR_RULES"]
+
+
+def _finding(program, rule_id: str, message: str) -> Finding:
+    return Finding(
+        rule=rule_id,
+        path=program.path,
+        line=program.line,
+        col=0,
+        message=message,
+        context=program.name,
+    )
+
+
+def _fmt_bytes(n: int) -> str:
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024.0 or unit == "TiB":
+            return f"{value:.1f}{unit}" if unit != "B" else f"{int(value)}B"
+        value /= 1024.0
+    return f"{int(n)}B"
+
+
+@ir_rule("DML601", "donation declared but dropped by the compiled executable")
+def check_dropped_donation(program) -> Iterator[Finding]:
+    """Donated arguments that alias NOTHING in the compiled program.
+
+    The signal is the compiled artifact's own ledger: the program
+    declared ``donate_argnums`` covering ``donated_bytes`` of input, yet
+    ``memory_analysis().alias_size_in_bytes`` is zero — XLA kept every
+    donated buffer alive alongside its output (double residency), which
+    is exactly the silent-drop warning jit prints once and discards.
+    A partial drop (alias bytes < donated bytes) fires too.
+    """
+    if not program.donate_argnums or program.compiled is None:
+        return
+    donated = program.donated_bytes
+    aliased = program.aliased_bytes
+    if donated is None or aliased is None:
+        return
+    if donated > 0 and aliased == 0:
+        msg = (
+            f"donate_argnums={tuple(program.donate_argnums)} declares "
+            f"{_fmt_bytes(donated)} donated, but the compiled executable "
+            f"aliases 0 bytes — jit dropped the donation (dtype/shape/"
+            f"sharding mismatch with every output), so the state lives "
+            f"twice in HBM"
+        )
+        if program.donation_warnings:
+            msg += f"; jit warned: {program.donation_warnings[0]}"
+        yield _finding(program, "DML601", msg)
+    elif donated > 0 and 0 < aliased < donated:
+        yield _finding(
+            program,
+            "DML601",
+            f"only {_fmt_bytes(aliased)} of {_fmt_bytes(donated)} declared-"
+            f"donated bytes alias an output in the compiled executable — "
+            f"part of the donation was silently dropped",
+        )
+
+
+@ir_rule("DML602", "traced collective/sharding axis does not resolve against the mesh")
+def check_unresolved_axes(program) -> Iterator[Finding]:
+    """Axis names the TRACED program uses vs the axes the mesh declares.
+
+    Walks the jaxpr equations (``program.collective_axes`` /
+    ``program.sharding_axes`` — collected by the tracer, recursing into
+    pjit/cond sub-jaxprs) and reports every axis name that is not one of
+    ``program.mesh_axes``. A trace that *failed* on an unbound axis
+    (``trace_error`` mentioning an axis name) fires here too: the
+    program cannot even be staged against this mesh.
+    """
+    if program.mesh_axes is None:
+        return
+    mesh = set(program.mesh_axes)
+    for axis, prim in sorted(program.collective_axes):
+        if axis not in mesh:
+            yield _finding(
+                program,
+                "DML602",
+                f"collective '{prim}' reduces over axis '{axis}' which is "
+                f"not a mesh axis {sorted(mesh)} — the traced program "
+                f"cannot run on this mesh",
+            )
+    for axis in sorted(program.sharding_axes):
+        if axis not in mesh:
+            yield _finding(
+                program,
+                "DML602",
+                f"sharding_constraint names axis '{axis}' which is not a "
+                f"mesh axis {sorted(mesh)}",
+            )
+    err = program.trace_error
+    if err and ("unbound axis" in err or "axis name" in err):
+        yield _finding(
+            program,
+            "DML602",
+            f"tracing failed resolving an axis against the mesh: {err}",
+        )
+
+
+#: jaxpr primitive names that are host round trips when they appear in a
+#: step program. ``debug_callback`` covers jax.debug.print/callback.
+_HOST_CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback"})
+
+
+@ir_rule("DML603", "host transfer baked into the traced step program")
+def check_host_transfers(program) -> Iterator[Finding]:
+    """Host callbacks in the jaxpr of a per-step program.
+
+    ``pure_callback``/``io_callback``/``debug_callback`` equations mean
+    XLA will round-trip to the host on EVERY step dispatch — a sync that
+    source rules can only guess at (the callback may be buried behind
+    any number of call layers; the jaxpr shows it flatly).
+    """
+    for prim, count in sorted(program.callback_prims.items()):
+        if prim in _HOST_CALLBACK_PRIMS:
+            times = f" x{count}" if count > 1 else ""
+            yield _finding(
+                program,
+                "DML603",
+                f"'{prim}'{times} is baked into the traced program — a "
+                f"host round trip on every step dispatch; hoist it out of "
+                f"the step or gate it behind a debug flag",
+            )
+
+
+@ir_rule("DML604", "estimated peak memory exceeds the declared HBM budget")
+def check_hbm_budget(program) -> Iterator[Finding]:
+    """Peak-memory preflight against a declared device budget.
+
+    Uses XLA's own compiled memory analysis when available (argument +
+    output + temp + generated code, minus bytes the executable aliases
+    via donation), falling back to the abstract argument/output sizes
+    when only shapes are known. Fails at lint time instead of OOM at
+    step 1.
+    """
+    budget = program.hbm_budget_bytes
+    if budget is None:
+        return
+    peak = program.peak_bytes
+    if peak is None:
+        return
+    if peak > budget:
+        source = "XLA memory analysis" if program.compiled is not None else "abstract shapes"
+        yield _finding(
+            program,
+            "DML604",
+            f"estimated peak device memory {_fmt_bytes(peak)} ({source}) "
+            f"exceeds the declared HBM budget {_fmt_bytes(budget)} by "
+            f"{_fmt_bytes(peak - budget)} — this program OOMs at step 1 "
+            f"on the declared device",
+        )
+
+
+@ir_rule("DML605", "enumerated signature surface exceeds the TraceGuard budget")
+def check_signature_surface(program) -> Iterator[Finding]:
+    """Static signature enumeration vs the declared trace budget.
+
+    The tracer enumerates the program's full signature surface (bucket
+    cross-product x prefill chunks x spec/medusa arms) and compares it
+    against the TraceGuard budget the program declared. TraceGuard
+    catches the overflow at runtime, on the trace that breaks the
+    budget; this catches it before any device work.
+    """
+    surface = program.signature_surface
+    budget = program.signature_budget
+    if surface is None or budget is None:
+        return
+    if surface > budget:
+        yield _finding(
+            program,
+            "DML605",
+            f"statically enumerated signature surface is {surface} "
+            f"(bucket cross-product incl. spec/medusa arms) but the "
+            f"TraceGuard budget is {budget} — the guard WILL fire; raise "
+            f"max_traces or shrink the bucket ladder",
+        )

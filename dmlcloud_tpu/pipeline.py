@@ -56,6 +56,8 @@ class TrainingPipeline:
         config: Any = None,
         name: Optional[str] = None,
         lint: Optional[str] = None,
+        verify: Optional[str] = None,
+        hbm_budget: Optional[int] = None,
         sanitize: Optional[str] = None,
         compile_cache: Any = None,
         precompile: bool = False,
@@ -68,6 +70,20 @@ class TrainingPipeline:
         work happens. None (default) skips linting — the CLI
         (``python -m dmlcloud_tpu lint``) and the self-lint test remain the
         review-time nets.
+
+        ``verify`` arms the IR-level verifier (dmlcloud_tpu.lint.ir; doc/
+        lint.md DML6xx) over the precompiled step executables at stage
+        start: each AOT-compiled train/val signature is re-audited as the
+        program XLA will actually run — donation that jit silently
+        dropped (DML601), collective/sharding axes that don't resolve
+        against the mesh (DML602), host callbacks baked into the step
+        (DML603), and — when ``hbm_budget`` (bytes) is declared —
+        estimated peak memory over budget (DML604). The arm re-uses the
+        executables ``precompile=True`` already built, so it adds zero
+        compiles; it therefore only runs where precompilation runs.
+        ``"warn"`` logs findings, ``"error"`` raises ``lint.LintError``
+        before the data loop. None (default) skips it — the CLI
+        (``python -m dmlcloud_tpu verify``) remains the review-time net.
 
         ``sanitize`` arms the RUNTIME sanitizer (dmlcloud_tpu.lint.sanitize)
         — the dynamic companion of the static pass: each stage's epoch runs
@@ -109,11 +125,17 @@ class TrainingPipeline:
         instrumentation points reduce to one attribute read."""
         if lint not in (None, "warn", "error"):
             raise ValueError(f'lint must be None, "warn" or "error", got {lint!r}')
+        if verify not in (None, "warn", "error"):
+            raise ValueError(f'verify must be None, "warn" or "error", got {verify!r}')
         if sanitize not in (None, "off", "warn", "error"):
             raise ValueError(f'sanitize must be None, "off", "warn" or "error", got {sanitize!r}')
         self.config: Config = as_config(config)
         self.name = name
         self._lint_mode = lint
+        self._verify_mode = verify
+        self._hbm_budget = None if hbm_budget is None else int(hbm_budget)
+        #: findings of the last verify preflight (stage.py fills this)
+        self.verify_findings: list = []
         from .lint.sanitize import Sanitizer
 
         self._sanitizer = Sanitizer(sanitize or "off", logger=logging.getLogger("dmlcloud_tpu"))

@@ -430,6 +430,8 @@ class ServeEngine:
         ledger_max_records: int | None = None,
         metrics: Any = None,
         slos: Any = None,
+        verify: str | None = None,
+        hbm_budget: int | None = None,
     ):
         from ..models.quant import prepare_decode_params
 
@@ -624,39 +626,24 @@ class ServeEngine:
                 max_traces=budget, action=guard, name=name,
             )
 
+        # the ONE signature-budget formula (signature_budget below) — the
+        # TraceGuard arms here and the DML605 verify check both consume it
+        budgets = self.signature_budget(
+            n_bb, n_tb,
+            spec=bool(self.spec_k), medusa=bool(self.medusa_k),
+            prefix_cache=self.prefix is not None,
+        )
+        self._step_budget = budgets["step"]
+        self.max_signatures = budgets["total"]
         if self.spec_k:
-            #: spec-mode signature budget: prefill is (1, chunk) x table
-            #: bucket x {target, draft} through _paged_step, PLUS the plain
-            #: decode signatures a draft-failure degraded round replays
-            #: (batch bucket x table bucket — failure isolation must never
-            #: trip the retrace guard); each healthy decode round is one
-            #: draft + one verify signature per (batch bucket x table
-            #: bucket). TraceGuard turns any growth into an error.
-            self._step_budget = 2 * n_tb + n_bb * n_tb
-            self._spec_budget = n_bb * n_tb
-            self.max_signatures = self._step_budget + 2 * self._spec_budget
+            self._spec_budget = budgets["spec"]
             self._draft_fn = _guarded(_spec_draft_step, self._spec_budget, "serve_spec_draft")
             self._verify_fn = _guarded(_spec_verify_step, self._spec_budget, "serve_spec_verify")
         elif self.medusa_k:
-            #: Medusa-mode budget: prefill is (1, chunk) x table bucket for
-            #: the TARGET ONLY (no draft mirror — that's the point), plain
-            #: decode keeps its (batch bucket x table bucket) fallback for
-            #: degraded rounds, and each healthy round is ONE fused
-            #: propose+verify signature per (batch bucket x table bucket).
-            #: vs spec mode the budget SHRINKS by n_tb (draft prefill) +
-            #: n_bb*n_tb (the second per-round signature): there is no
-            #: draft anything to trace.
-            self._step_budget = n_bb * n_tb + n_tb
-            self._medusa_budget = n_bb * n_tb
-            self.max_signatures = self._step_budget + self._medusa_budget
+            self._medusa_budget = budgets["medusa"]
             self._draft_fn = self._verify_fn = None
             self._medusa_fn = _guarded(_medusa_step, self._medusa_budget, "serve_medusa_step")
         else:
-            #: the engine's whole compiled-signature budget: decode is
-            #: (batch bucket x table bucket), prefill is (1, chunk) x table
-            #: bucket.
-            self._step_budget = n_bb * n_tb + n_tb
-            self.max_signatures = self._step_budget
             self._draft_fn = self._verify_fn = None
         if not self.medusa_k:
             self._medusa_fn = None
@@ -666,7 +653,146 @@ class ServeEngine:
             # COW fork: traced src/dst -> ONE signature for every fork the
             # engine ever performs (counted in the budget)
             self._copy_fn = _guarded(_copy_block, 1, "serve_cow_copy", statics=())
-            self.max_signatures += 1
+
+        if verify not in (None, "warn", "error"):
+            raise ValueError(f'verify must be None, "warn" or "error", got {verify!r}')
+        self._verify_mode = verify
+        self.hbm_budget = None if hbm_budget is None else int(hbm_budget)
+        #: findings of the construction-time verify preflight (if armed)
+        self.verify_findings: list = []
+        if verify:
+            self._run_verify_preflight(verify)
+
+    @staticmethod
+    def signature_budget(
+        n_batch_buckets: int,
+        n_table_buckets: int,
+        *,
+        spec: bool = False,
+        medusa: bool = False,
+        prefix_cache: bool = False,
+    ) -> dict:
+        """THE signature-budget formula — every compiled signature a healthy
+        engine can legitimately own, by decode mode. The constructor's
+        TraceGuard arms and the DML605 verify check both read this one
+        function, asserted equal to the historical per-mode math by
+        ``tests/test_verify.py`` — so the budget can never again drift
+        between the runtime guard and the static check.
+
+        Returns ``{"step", "spec", "medusa", "copy", "total"}``:
+
+        - plain decode: ``step`` is (batch bucket x table bucket) decode
+          plus (1, chunk) x table-bucket prefill — ``n_bb*n_tb + n_tb``.
+        - spec mode: prefill doubles (target + draft mirror through
+          ``_paged_step``: ``2*n_tb``) and plain decode stays as the
+          degraded-round fallback (``n_bb*n_tb``); each healthy round adds
+          one draft + one verify signature per (batch x table) bucket —
+          ``spec = n_bb*n_tb``, counted twice in ``total``.
+        - Medusa mode: target-only prefill (no draft mirror), the plain
+          decode fallback, and ONE fused propose+verify signature per
+          (batch x table) bucket — ``medusa = n_bb*n_tb``.
+        - ``prefix_cache`` adds the single traced COW-copy signature.
+        """
+        n_bb, n_tb = int(n_batch_buckets), int(n_table_buckets)
+        if spec and medusa:
+            raise ValueError("spec and medusa are mutually exclusive decode modes")
+        if spec:
+            step, spec_b, medusa_b = 2 * n_tb + n_bb * n_tb, n_bb * n_tb, 0
+            total = step + 2 * spec_b
+        elif medusa:
+            step, spec_b, medusa_b = n_bb * n_tb + n_tb, 0, n_bb * n_tb
+            total = step + medusa_b
+        else:
+            step, spec_b, medusa_b = n_bb * n_tb + n_tb, 0, 0
+            total = step
+        copy = 1 if prefix_cache else 0
+        return {"step": step, "spec": spec_b, "medusa": medusa_b, "copy": copy,
+                "total": total + copy}
+
+    def _enumerate_signature_surface(self) -> int:
+        """Count every signature this engine can legitimately compile by
+        EXPLICIT per-bucket enumeration — deliberately NOT a call into
+        :meth:`signature_budget`, so the DML605 preflight compares two
+        independent derivations and catches either one drifting."""
+        surface = 0
+        for _tb in self.table_buckets:
+            surface += 1  # target prefill: (1, chunk) x this table bucket
+            if self.spec_k:
+                surface += 1  # draft prefill mirror through _paged_step
+        for _bb in self.batch_buckets:
+            for _tb in self.table_buckets:
+                surface += 1  # plain decode (spec/medusa degraded fallback)
+                if self.spec_k:
+                    surface += 2  # one draft + one verify per healthy round
+                if self.medusa_k:
+                    surface += 1  # the fused propose+verify round
+        if self.prefix is not None:
+            surface += 1  # the traced COW copy
+        return surface
+
+    def _run_verify_preflight(self, mode: str) -> None:
+        """Construction-time IR verify (doc/lint.md DML6xx): stage the
+        worst-case (max batch bucket x max table bucket) decode step on
+        CPU and audit its donation contract, baked-in host callbacks and
+        memory estimate against ``hbm_budget``, plus the DML605 check
+        that the enumerated signature surface fits ``max_signatures``.
+        AOT lower/compile never touches the jit dispatch cache, so the
+        TraceGuard budgets are unaffected. ``"warn"`` emits a warning
+        with the findings; ``"error"`` raises :class:`LintError`."""
+        import warnings
+
+        from ..compile import aot
+        from ..lint import LintError
+        from ..lint import ir as ir_mod
+
+        bb = max(self.batch_buckets)
+        tb = max(self.table_buckets)
+        sds = jax.ShapeDtypeStruct
+        f32, i32 = jnp.float32, jnp.int32
+        specs = [
+            ir_mod.ProgramSpec(
+                name="serve.signature_surface",
+                fn=None,
+                signature_surface=self._enumerate_signature_surface(),
+                signature_budget=self.max_signatures,
+                kind="serve",
+            ),
+            ir_mod.ProgramSpec(
+                name=f"serve.paged_step[b{bb}xt{tb}]",
+                fn=self._step_fn._fn,
+                args=(
+                    aot.abstract_spec(self.pool.pools),
+                    aot.abstract_spec(self.params),
+                    sds((bb, tb), i32),   # block tables
+                    sds((bb,), i32),      # fill
+                    sds((bb, 1), i32),    # tokens
+                    sds((bb,), i32),      # last_idx
+                    aot.abstract_spec(self._rng),
+                    None,                 # adapters
+                    sds((bb,), f32),      # temperature
+                    sds((bb,), i32),      # top_k
+                    sds((bb,), f32),      # top_p
+                ),
+                static_kwargs={"model": self.model},
+                donate_argnums=(0,),
+                hbm_budget_bytes=self.hbm_budget,
+                kind="serve",
+            ),
+        ]
+        stats: dict = {}
+        findings = ir_mod.verify_programs(specs, stats=stats)
+        self.verify_findings = list(findings)
+        if not findings:
+            return
+        report = "\n".join(f.format() for f in findings)
+        msg = (
+            f"IR verifier found {len(findings)} problem(s) in the serve step "
+            f"programs (doc/lint.md DML6xx; suppress with "
+            f"'# dmllint: disable=ID'):\n{report}"
+        )
+        if mode == "error":
+            raise LintError(msg, findings=findings)
+        warnings.warn(msg, stacklevel=3)
 
     # -- request lifecycle ---------------------------------------------------
     def submit(

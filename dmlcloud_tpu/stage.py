@@ -969,8 +969,10 @@ class TrainValStage(Stage):
             return out
 
         n_train = 0
+        verify_args: list[tuple] = []
         for gs in global_specs(self._host_batch_spec(self.train_dataset)):
             self._train_compiled.precompile(state_spec, gs)
+            verify_args.append(("train_step", self._train_compiled, (state_spec, gs), (0,)))
             n_train += 1
         # val is best-effort: a stage may have no val dataset, or one whose
         # first-batch peek is impossible — the val step then compiles lazily
@@ -978,6 +980,7 @@ class TrainValStage(Stage):
         try:
             for gs in global_specs(self._host_batch_spec(self.val_dataset)):
                 self._val_compiled.precompile(state_spec, gs)
+                verify_args.append(("val_step", self._val_compiled, (state_spec, gs), ()))
                 n_val += 1
         except ValueError as e:
             self.logger.warning(f"val-step precompile skipped: {e}")
@@ -997,6 +1000,54 @@ class TrainValStage(Stage):
                 f"precompile() on stage {self.name!r} found no batch spec to compile "
                 "against; the first step pays the compile as usual"
             )
+        self._verify_precompiled(verify_args)
+
+    def _verify_precompiled(self, verify_args: list[tuple]) -> None:
+        """The ``TrainingPipeline(verify=...)`` arm: audit every executable
+        the precompile phase just built with the IR verifier (doc/lint.md
+        DML6xx) BEFORE the data loop. Re-uses the compiled artifacts — the
+        preflight adds jaxpr traces (cheap, no XLA) but zero compiles."""
+        mode = getattr(self.pipeline, "_verify_mode", None)
+        if not mode or not verify_args:
+            return
+        from .compile import aot
+        from .lint import LintError
+        from .lint import ir as ir_mod
+
+        budget = getattr(self.pipeline, "_hbm_budget", None)
+        specs = []
+        for step_name, reg, args, donate in verify_args:
+            specs.append(
+                ir_mod.ProgramSpec(
+                    name=f"{self.name}.{step_name}[{len(specs)}]",
+                    fn=reg._fn,
+                    args=args,
+                    donate_argnums=donate,
+                    mesh=self.mesh,
+                    hbm_budget_bytes=budget,
+                    kind="train",
+                    compiled=reg._compiled.get(aot.signature_of(args)),
+                )
+            )
+        t0 = time.perf_counter()
+        findings = ir_mod.verify_programs(specs)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        self.pipeline.verify_findings = list(findings)
+        self.logger.info(
+            f"verify: {len(findings)} finding(s) over {len(specs)} precompiled "
+            f"program(s) in {elapsed_ms:.0f} ms"
+        )
+        if not findings:
+            return
+        report = "\n".join(f.format() for f in findings)
+        if mode == "error":
+            raise LintError(
+                f"IR verifier found {len(findings)} problem(s) in the precompiled "
+                f"step programs (doc/lint.md DML6xx; suppress with "
+                f"'# dmllint: disable=ID'):\n{report}",
+                findings=findings,
+            )
+        self.logger.warning("IR verifier findings in precompiled step programs:\n%s", report)
 
     def _pre_epoch(self):
         self._stall.reset()  # misc/host_stall_ms is a per-epoch total

@@ -72,6 +72,7 @@ SPAN_KINDS = frozenset(
         "checkpoint",  # save dispatch / commit wait
         "barrier",  # control-plane barrier
         "compile",  # AOT precompile of one signature
+        "preflight",  # IR-level verify of one program (lint/ir.py; "verify" is taken by spec decode)
         "host_stall",  # any other accounted host block (StallTimer)
         "watchdog",  # forensics dump events
         "sanitizer",  # runtime sanitizer violations (lint/sanitize.py)

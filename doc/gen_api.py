@@ -58,6 +58,8 @@ MODULES = [
     ("dmlcloud_tpu.serve.slo", "Declarative SLOs with multi-window burn-rate alerting."),
     ("dmlcloud_tpu.serve.metrics_http", "Stdlib HTTP endpoint for Prometheus scrapes."),
     ("dmlcloud_tpu.telemetry.metrics_registry", "Typed metrics: counters, gauges, histograms, Prometheus text."),
+    ("dmlcloud_tpu.lint.ir", "IR-level program verifier: trace, AOT-compile, audit (DML6xx)."),
+    ("dmlcloud_tpu.lint.rules_ir", "The DML6xx rules over traced/compiled step programs."),
     ("dmlcloud_tpu.data.datasets", "Composable data pipelines + reference-parity shims."),
     ("dmlcloud_tpu.data.store", "Disk-native data plane: mmap'd .dmlshard corpora + async ShardReader."),
     ("dmlcloud_tpu.data.sharding", "Per-process dataset index sharding."),

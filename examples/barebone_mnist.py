@@ -71,6 +71,46 @@ class BareboneMnistStage(dml.Stage):
         return cols
 
 
+def dml_verify_programs():
+    """IR-verify hook (``python -m dmlcloud_tpu verify examples/``): the
+    example's train step on abstract shapes, donation contract included —
+    the same math the jitted closure in ``pre_stage`` compiles, so the
+    DML6xx preflight audits what users will actually copy."""
+    from dmlcloud_tpu.lint.ir import ProgramSpec
+
+    model = MnistCNN()
+    tx = optax.adam(1e-3)
+
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["image"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+    )
+    opt_state = jax.eval_shape(tx.init, params)
+    batch = {
+        "image": jax.ShapeDtypeStruct((32, 28, 28, 1), jnp.float32),
+        "label": jax.ShapeDtypeStruct((32,), jnp.int32),
+    }
+    return [
+        ProgramSpec(
+            name="barebone_mnist.train_step",
+            fn=train_step,
+            args=(params, opt_state, batch),
+            donate_argnums=(0, 1),
+            kind="train",
+        )
+    ]
+
+
 def main():
     init_auto(verbose=True)
     pipeline = dml.TrainingPipeline(name="barebone-mnist")

@@ -1,0 +1,156 @@
+"""Bench receipt for the IR-level program verifier (doc/lint.md DML6xx):
+verify wall seconds over pinned train + serve step configs, plus the two
+defect-detection bits the PR-20 acceptance locks.
+
+``verify_wall_s`` is the cost of the preflight CI pays on every
+``lint --ir`` / ``python -m dmlcloud_tpu verify`` invocation — a
+lower-is-better latency gated like ``lint_cold_wall_s``. The two
+``verify_caught_*`` ints are pass/fail contracts measured on DOCTORED
+programs: a dtype-mismatched donation that compiles clean (the silent
+drop DML205 cannot see — DML601 must catch it) and a step whose declared
+HBM budget it provably exceeds (DML604 must catch it). A verifier that
+goes blind flips the bit to 0 and ``bench.py --gate --suite lint`` fails
+on the committed receipt.
+
+    JAX_PLATFORMS=cpu python scripts/bench_verify.py [-o BENCH_verify_pr20.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dmlcloud_tpu.lint.ir import ProgramSpec, verify_programs  # noqa: E402
+
+#: pinned config: a donating train-style step (params + sgd update) and a
+#: donating serve-style decode step (kv-cache append + logits) — small
+#: enough for a CI box, shaped like the real programs the runtime arms
+#: stage at precompile/engine-construction time
+_DIM = 64
+
+
+def _train_step(params, batch):
+    w1, w2 = params
+    h = jnp.tanh(batch["x"] @ w1)
+    pred = h @ w2
+    loss = jnp.mean((pred - batch["y"]) ** 2)
+    g1, g2 = jax.grad(lambda p: jnp.mean(((jnp.tanh(batch["x"] @ p[0])) @ p[1] - batch["y"]) ** 2))(params)
+    return (w1 - 0.05 * g1, w2 - 0.05 * g2), loss
+
+
+def _serve_step(cache, params, token):
+    h = token @ params
+    cache = cache.at[:, -1].set(h)
+    return cache, h @ params.T
+
+
+def _pinned_specs():
+    f32 = jnp.float32
+    params = (jax.ShapeDtypeStruct((_DIM, _DIM), f32),
+              jax.ShapeDtypeStruct((_DIM, _DIM), f32))
+    batch = {"x": jax.ShapeDtypeStruct((8, _DIM), f32),
+             "y": jax.ShapeDtypeStruct((8, _DIM), f32)}
+    cache = jax.ShapeDtypeStruct((4, 16, _DIM), f32)
+    w = jax.ShapeDtypeStruct((_DIM, _DIM), f32)
+    tok = jax.ShapeDtypeStruct((4, _DIM), f32)
+    return [
+        ProgramSpec(name="train_step", fn=_train_step,
+                    args=(params, batch), donate_argnums=(0,), kind="train"),
+        ProgramSpec(name="serve_step", fn=_serve_step,
+                    args=(cache, w, tok), donate_argnums=(0,), kind="serve"),
+    ]
+
+
+def _dropped_donation_step(state, batch):
+    # int32 state donated, float32 state returned: compiles clean, aliases 0
+    return state.astype(jnp.float32) * 2.0 + batch
+
+
+def _doctored_specs():
+    i32, f32 = jnp.int32, jnp.float32
+    return [
+        ProgramSpec(name="doctored_donation", fn=_dropped_donation_step,
+                    args=(jax.ShapeDtypeStruct((64, 64), i32),
+                          jax.ShapeDtypeStruct((64, 64), f32)),
+                    donate_argnums=(0,)),
+        ProgramSpec(name="doctored_oom", fn=lambda x: x @ x.T,
+                    args=(jax.ShapeDtypeStruct((64, 64), f32),),
+                    hbm_budget_bytes=1024),
+    ]
+
+
+def dml_verify_programs():
+    """IR-verify hook: the bench child's pinned train+serve configs ARE
+    verifiable programs — ``python -m dmlcloud_tpu verify scripts/`` (and
+    the self-verify lock in test_selflint.py) audits the exact programs
+    this bench times, so the receipt can never be measured on programs
+    the verifier would reject."""
+    return _pinned_specs()
+
+
+def measure(repeats: int = 3) -> dict | None:
+    """Best-of-N verify wall seconds over the pinned clean configs, plus
+    the defect-detection bits on the doctored programs. Returns None if
+    the clean configs themselves produce findings (the bench must measure
+    the verifier, not fight it)."""
+    wall_best = float("inf")
+    programs = 0
+    for _ in range(repeats):
+        stats: dict = {}
+        t0 = time.perf_counter()
+        findings = verify_programs(_pinned_specs(), stats=stats)
+        wall_best = min(wall_best, time.perf_counter() - t0)
+        programs = stats.get("programs", 0)
+        if findings:
+            return None
+    doctored = verify_programs(_doctored_specs())
+    rules = {f.rule for f in doctored}
+    return {
+        "bench": "verify_preflight",
+        "value_source": "cpu_smoke",
+        "host": {
+            "cpu_count": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        "programs": programs,
+        "repeats_best_of": repeats,
+        "gate": {
+            "verify_wall_s": round(wall_best, 4),
+            "verify_caught_donation": int("DML601" in rules),
+            "verify_caught_oom": int("DML604" in rules),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("-o", "--output", default=os.path.join(REPO, "BENCH_verify_pr20.json"))
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    receipt = measure(repeats=args.repeats)
+    if receipt is None:
+        print("bench_verify: FAIL — the pinned clean configs produced findings", file=sys.stderr)
+        return 1
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(receipt, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(receipt, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1178,3 +1178,93 @@ def test_committed_obs_receipt_satisfies_the_gate():
     assert drill["all_terminal"] is True
     assert drill["leaked_blocks"] == 0
     assert drill["metrics_families"] > 0
+
+
+# ---------------------------------------------- lint suite: IR verifier
+
+VERIFY_RECEIPT = {
+    "value_source": "cpu_smoke",
+    "gate": {
+        "verify_wall_s": 0.05,
+        "verify_caught_donation": 1,
+        "verify_caught_oom": 1,
+    },
+}
+
+
+def test_verify_gate_passes_against_itself(tmp_path):
+    base = _write(tmp_path, "BENCH_verify_base.json", VERIFY_RECEIPT)
+    assert run_gate(base, current=dict(VERIFY_RECEIPT)) == 0
+
+
+def test_verify_wall_is_lower_is_better(tmp_path, capsys):
+    """The preflight wall time is a latency-class metric: growing past
+    the wide latency tolerance FAILS naming the key, shrinking (a faster
+    tracer) always passes."""
+    slow = json.loads(json.dumps(VERIFY_RECEIPT))
+    slow["gate"]["verify_wall_s"] = 1.0  # 20x the committed cost
+    base = _write(tmp_path, "BENCH_verify_base.json", VERIFY_RECEIPT)
+    assert run_gate(base, current=slow) == 1
+    assert "verify_wall_s" in capsys.readouterr().out
+    fast = json.loads(json.dumps(VERIFY_RECEIPT))
+    fast["gate"]["verify_wall_s"] = 0.001
+    assert run_gate(base, current=fast) == 0
+
+
+def test_verify_caught_bits_are_pass_fail(tmp_path, capsys):
+    """The doctored-regression lock: the dropped-donation and the
+    HBM-exceeding defect are planted on every bench run, and a verifier
+    that stops catching either one (bit -> 0) FAILS outright."""
+    base = _write(tmp_path, "BENCH_verify_base.json", VERIFY_RECEIPT)
+    for key in ("verify_caught_donation", "verify_caught_oom"):
+        blind = json.loads(json.dumps(VERIFY_RECEIPT))
+        blind["gate"][key] = 0
+        assert run_gate(base, current=blind) == 1
+        assert key in capsys.readouterr().out
+
+
+def test_verify_missing_metric_fails(tmp_path, capsys):
+    """A verify metric that silently vanishes is a FAIL, like every suite."""
+    current = {"gate": {"verify_wall_s": 0.01, "verify_caught_donation": 1}}
+    base = _write(tmp_path, "BENCH_verify_base.json", VERIFY_RECEIPT)
+    assert run_gate(base, current=current) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+
+def test_gate_main_lint_suite_merges_verify_receipts(tmp_path, monkeypatch):
+    """The lint suite's merged baseline folds BENCH_verify_*.json in next
+    to the lint receipts: dropping a verify key from the current run
+    FAILS even when every lint key is healthy."""
+    import bench as bench_mod
+
+    lint = {"gate": {"lint_cold_wall_s": 5.0, "lint_warm_wall_s": 0.1,
+                     "lint_incremental_ok": 1}}
+    verify = {"gate": dict(VERIFY_RECEIPT["gate"])}
+    _write(tmp_path, "BENCH_lint_a.json", lint)
+    _write(tmp_path, "BENCH_verify_pr20.json", verify)
+    monkeypatch.setattr(
+        bench_mod.os.path, "dirname", lambda p, _real=bench_mod.os.path.dirname: str(tmp_path)
+    )
+    both = {"gate": {**lint["gate"], **verify["gate"]}}
+    cur = _write(tmp_path, "cur.json", both)
+    assert gate_main(["--gate", "--suite", "lint", "--current", cur]) == 0
+    partial = _write(tmp_path, "partial.json", lint)
+    assert gate_main(["--gate", "--suite", "lint", "--current", partial]) == 1
+
+
+def test_committed_verify_receipt_satisfies_the_gate():
+    """The committed PR 20 receipt must pass its own gate and meet the
+    acceptance lock: BOTH doctored defects caught (the dropped donation
+    DML205 passes clean, and the HBM-budget overrun)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "BENCH_verify_pr20.json")
+    if not os.path.exists(path):
+        pytest.skip("receipt not committed yet")
+    assert run_gate(path, current=path) == 0
+    receipt = json.load(open(path))
+    gate = receipt["gate"]
+    assert gate["verify_caught_donation"] == 1
+    assert gate["verify_caught_oom"] == 1
+    assert gate["verify_wall_s"] > 0.0
+    assert receipt["value_source"] == "cpu_smoke"
+    assert receipt["programs"] == 2

@@ -47,6 +47,28 @@ def test_examples_lint_clean():
     )
 
 
+def test_examples_and_bench_configs_verify_clean():
+    """Self-VERIFY gate (PR 20): the IR-level pass over every example and
+    bench-child config that registers a ``dml_verify_programs()`` hook —
+    the programs users copy and the programs the perf receipts time must
+    clear the DML6xx contracts (donation effective in the compiled
+    artifact, no baked-in host callbacks, axes resolving, budgets met).
+    Any justified suppression carries a rationale comment at its anchor."""
+    from dmlcloud_tpu.lint.ir import verify_paths
+
+    targets = [p for p in (REPO_ROOT / "examples", REPO_ROOT / "scripts") if p.exists()]
+    if not targets:  # installed-package runs carry neither
+        pytest.skip("examples/ and scripts/ not present next to the package")
+    stats: dict = {}
+    findings = verify_paths(targets, stats=stats)
+    assert findings == [], (
+        f"examples/scripts programs violate the IR-verify contract:\n{_report(findings)}\n"
+        "Fix the program or suppress with '# dmllint: disable=ID -- why'."
+    )
+    # the lock is meaningful only while hooks exist and programs trace
+    assert stats["programs"] >= 3
+
+
 def test_bench_and_scripts_lint_clean():
     """bench.py and scripts/ produce the numbers the perf claims rest on —
     a dishonest timing loop or a donated-buffer read THERE corrupts the

@@ -57,6 +57,10 @@ V1_KINDS = {
     # observability plane (PR 19): admission into a decode slot, prefix
     # cache lookups, copy-on-write forks, SLO burn-rate alerts
     "admission", "prefix_lookup", "cow_fork", "slo_alert",
+    # IR-level verifier (PR 20): one traced/audited program per span
+    # (named "preflight" because "verify" was already the spec-decode
+    # verification pass)
+    "preflight",
 }
 
 #: Core fields every v1 record carries, with their types.

@@ -1,0 +1,37 @@
+"""Fixture: donation DECLARED but silently DROPPED — exactly 1 DML601.
+
+The jitted step donates its state argument, so the AST donation rule
+(DML205) is satisfied and stays quiet — the declaration is right there
+in the ``jax.jit`` call. But the donated buffer is int32 and the updated
+state the step returns is float32: XLA cannot alias buffers of different
+element types, so the donation is dropped at compile time with nothing
+but a warning, and the step double-buffers its largest argument on every
+call. Only the compiled artifact's alias table (DML601) can see this.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def dropped_donation_step(state, batch):
+    # same shape, DIFFERENT dtype: the "updated state" can never reuse
+    # the donated int32 pages
+    return state.astype(jnp.float32) * 2.0 + batch
+
+
+step_jit = jax.jit(dropped_donation_step, donate_argnums=(0,))
+
+
+def dml_verify_programs():
+    from dmlcloud_tpu.lint.ir import ProgramSpec
+
+    state = jax.ShapeDtypeStruct((64, 64), jnp.int32)
+    batch = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return [
+        ProgramSpec(
+            name="dropped_donation_step",
+            fn=step_jit,
+            args=(state, batch),
+            donate_argnums=(0,),
+        )
+    ]

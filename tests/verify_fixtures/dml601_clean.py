@@ -1,0 +1,31 @@
+"""Fixture: the healthy donation twin of dml601_bad — zero findings.
+
+Identical structure, but the updated state matches the donated buffer in
+shape AND dtype, so XLA aliases the full argument and the verifier sees
+``aliased == donated``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def clean_donation_step(state, batch):
+    return state * 2.0 + batch  # same shape + dtype: aliases fully
+
+
+step_jit = jax.jit(clean_donation_step, donate_argnums=(0,))
+
+
+def dml_verify_programs():
+    from dmlcloud_tpu.lint.ir import ProgramSpec
+
+    state = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    batch = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return [
+        ProgramSpec(
+            name="clean_donation_step",
+            fn=step_jit,
+            args=(state, batch),
+            donate_argnums=(0,),
+        )
+    ]

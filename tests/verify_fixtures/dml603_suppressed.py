@@ -1,0 +1,36 @@
+"""Fixture: a host callback baked into a step program, SUPPRESSED at the
+anchor line — zero findings, proving ``# dmllint: disable=`` reaches the
+IR pass.
+
+The twin program below it is NOT suppressed — exactly 1 DML603.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _host_log(x):
+    return np.asarray(x)
+
+
+def suppressed_callback_step(x):  # dmllint: disable=DML603
+    # deliberate, rationale: this fixture program EXISTS to prove the
+    # suppression path; a real step would carry a why-comment like this
+    y = jax.pure_callback(_host_log, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y * 2.0
+
+
+def flagged_callback_step(x):
+    y = jax.pure_callback(_host_log, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y * 2.0
+
+
+def dml_verify_programs():
+    from dmlcloud_tpu.lint.ir import ProgramSpec
+
+    x = jax.ShapeDtypeStruct((8,), jnp.float32)
+    return [
+        ProgramSpec(name="suppressed_callback_step", fn=suppressed_callback_step, args=(x,)),
+        ProgramSpec(name="flagged_callback_step", fn=flagged_callback_step, args=(x,)),
+    ]

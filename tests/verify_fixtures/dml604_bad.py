@@ -1,0 +1,29 @@
+"""Fixture: declared HBM budget the step provably exceeds — exactly 1
+DML604.
+
+The program's arguments alone (two 64x64 float32 arrays = 32KiB) dwarf
+the declared 1024-byte budget, so whichever estimator runs (XLA's
+memory_analysis on the compiled artifact, or the abstract-shape fallback)
+must fire.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def hbm_hog_step(x):
+    return x @ x.T + x
+
+
+def dml_verify_programs():
+    from dmlcloud_tpu.lint.ir import ProgramSpec
+
+    x = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return [
+        ProgramSpec(
+            name="hbm_hog_step",
+            fn=hbm_hog_step,
+            args=(x,),
+            hbm_budget_bytes=1024,
+        )
+    ]
